@@ -1,0 +1,46 @@
+"""sparkdl_tpu — TPU-native Deep Learning Pipelines.
+
+Public surface mirrors the reference's ``sparkdl`` package (SURVEY.md 2.21,
+[U: python/sparkdl/__init__.py]): the same transformer/estimator/UDF names,
+re-implemented on JAX/XLA for TPU. Imports are lazy so that lightweight uses
+(image IO, params) do not pull in flax/TF.
+"""
+
+from sparkdl_tpu.version import __version__
+
+_LAZY = {
+    # name -> module path
+    "DeepImageFeaturizer": "sparkdl_tpu.transformers.named_image",
+    "DeepImagePredictor": "sparkdl_tpu.transformers.named_image",
+    "KerasTransformer": "sparkdl_tpu.transformers.keras_tensor",
+    "KerasImageFileTransformer": "sparkdl_tpu.transformers.keras_image",
+    "TFTransformer": "sparkdl_tpu.transformers.tf_tensor",
+    "TFImageTransformer": "sparkdl_tpu.transformers.tf_image",
+    "KerasImageFileEstimator": "sparkdl_tpu.estimators.keras_image_file_estimator",
+    "TFInputGraph": "sparkdl_tpu.graph.input",
+    "GraphFunction": "sparkdl_tpu.graph.builder",
+    "IsolatedSession": "sparkdl_tpu.graph.builder",
+    "registerKerasImageUDF": "sparkdl_tpu.udf.keras_image_model",
+    "TPURunner": "sparkdl_tpu.runner.tpu_runner",
+    "HorovodRunner": "sparkdl_tpu.runner.tpu_runner",
+    "imageIO": "sparkdl_tpu.image",
+    "readImages": "sparkdl_tpu.image.imageIO",
+    "readImagesWithCustomFn": "sparkdl_tpu.image.imageIO",
+}
+
+__all__ = sorted(_LAZY) + ["__version__"]
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name])
+        obj = getattr(mod, name)
+        globals()[name] = obj
+        return obj
+    raise AttributeError(f"module 'sparkdl_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return __all__

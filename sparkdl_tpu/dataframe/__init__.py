@@ -1,0 +1,16 @@
+from sparkdl_tpu.dataframe.local import LocalDataFrame, Row
+from sparkdl_tpu.dataframe.adapters import (
+    columns_of,
+    is_spark_df,
+    make_dataframe,
+    transform_partitions,
+)
+
+__all__ = [
+    "LocalDataFrame",
+    "Row",
+    "columns_of",
+    "is_spark_df",
+    "make_dataframe",
+    "transform_partitions",
+]

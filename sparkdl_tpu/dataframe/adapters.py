@@ -1,0 +1,127 @@
+"""Backend dispatch: run a per-partition row transform on any DataFrame.
+
+Transformers in this framework are written once, as a partition function
+``fn(iter[dict]) -> iter[dict]`` (mirroring how the reference pushes work to
+executors per partition, SURVEY.md 3.1). This module runs that function over:
+
+  * LocalDataFrame   — in-process, partition by partition
+  * pandas.DataFrame — treated as a single partition
+  * pyarrow.Table    — treated as a single partition
+  * pyspark DataFrame — via ``mapInPandas`` so the model executes inside
+    executors next to their TPU hosts (gated: pyspark optional)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator
+
+import numpy as np
+import pandas as pd
+
+from sparkdl_tpu.dataframe.local import LocalDataFrame
+
+
+def _spark_df_type():
+    try:
+        from pyspark.sql import DataFrame as SparkDataFrame
+
+        return SparkDataFrame
+    except ImportError:
+        return None
+
+
+def is_spark_df(df) -> bool:
+    t = _spark_df_type()
+    return t is not None and isinstance(df, t)
+
+
+def make_dataframe(rows, backend: str = "local", num_partitions: int | None = None):
+    if backend != "local":
+        raise ValueError(f"unknown dataframe backend {backend!r}")
+    return LocalDataFrame.from_rows(rows, num_partitions)
+
+
+def columns_of(df) -> list[str]:
+    if isinstance(df, LocalDataFrame):
+        return df.columns
+    if isinstance(df, pd.DataFrame):
+        return list(df.columns)
+    try:
+        import pyarrow as pa
+
+        if isinstance(df, pa.Table):
+            return df.column_names
+    except ImportError:
+        pass
+    if is_spark_df(df):
+        return df.columns
+    raise TypeError(f"unsupported DataFrame type {type(df)}")
+
+
+def transform_partitions(
+    df,
+    fn: Callable[[Iterator[dict]], Iterable[dict]],
+    output_schema: "list[tuple[str, str]] | None" = None,
+):
+    """Apply ``fn`` per partition, returning a DataFrame of the same backend.
+
+    ``output_schema`` is a list of (name, spark_ddl_type) for the *added*
+    columns; it is required for the pyspark backend (mapInPandas needs a
+    schema) and ignored for local backends.
+    """
+    if isinstance(df, LocalDataFrame):
+        return df.mapPartitions(fn)
+    if isinstance(df, pd.DataFrame):
+        rows = list(fn(iter(df.to_dict("records"))))
+        return pd.DataFrame(rows)
+    try:
+        import pyarrow as pa
+
+        if isinstance(df, pa.Table):
+            rows = list(fn(iter(df.to_pylist())))
+            return pa.Table.from_pylist(rows)
+    except ImportError:
+        pass
+    if is_spark_df(df):
+        return _transform_spark(df, fn, output_schema)
+    raise TypeError(f"unsupported DataFrame type {type(df)}")
+
+
+def _transform_spark(df, fn, output_schema):
+    """pyspark path: ship ``fn`` to executors via mapInPandas.
+
+    Each executor partition becomes an iterator of pandas chunks; we flatten
+    to row dicts, run the same partition function the local backends use,
+    and re-assemble pandas frames. One JAX process per executor does the TPU
+    work (SURVEY.md §7 design stance: Spark pumps data, JAX owns execution).
+    """
+    if output_schema is None:
+        raise ValueError("output_schema is required for the pyspark backend")
+    in_schema = df.schema
+    from pyspark.sql.types import StructType, _parse_datatype_string
+
+    out_schema = StructType(list(in_schema.fields))
+    for name, ddl in output_schema:
+        field_type = _parse_datatype_string(ddl)
+        out_schema = out_schema.add(name, field_type)
+
+    def run(chunks: Iterator[pd.DataFrame]) -> Iterator[pd.DataFrame]:
+        def rows() -> Iterator[dict]:
+            for chunk in chunks:
+                yield from chunk.to_dict("records")
+
+        out_rows = []
+        for r in fn(rows()):
+            out_rows.append(r)
+            if len(out_rows) >= 1024:
+                yield pd.DataFrame(out_rows)
+                out_rows = []
+        if out_rows:
+            yield pd.DataFrame(out_rows)
+
+    return df.mapInPandas(run, schema=out_schema)
+
+
+def get_column_block(rows: list[dict], col: str) -> np.ndarray:
+    """Stack one column of a row block into a numpy array."""
+    return np.asarray([r[col] for r in rows])

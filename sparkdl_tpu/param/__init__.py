@@ -1,0 +1,27 @@
+from sparkdl_tpu.param.shared_params import (
+    Estimator,
+    HasBatchSize,
+    HasInputCol,
+    HasLabelCol,
+    HasOutputCol,
+    Param,
+    Params,
+    Pipeline,
+    PipelineModel,
+    Transformer,
+)
+from sparkdl_tpu.param.converters import SparkDLTypeConverters
+
+__all__ = [
+    "Estimator",
+    "HasBatchSize",
+    "HasInputCol",
+    "HasLabelCol",
+    "HasOutputCol",
+    "Param",
+    "Params",
+    "Pipeline",
+    "PipelineModel",
+    "SparkDLTypeConverters",
+    "Transformer",
+]

@@ -1,0 +1,36 @@
+from sparkdl_tpu.runtime.dtypes import DtypePolicy, default_policy, FLOAT32
+from sparkdl_tpu.runtime.mesh import (
+    AXIS_ORDER,
+    MeshSpec,
+    batch_sharding,
+    data_parallel_mesh,
+    replicated_sharding,
+    single_device_mesh,
+)
+from sparkdl_tpu.runtime.batching import (
+    PaddedBatch,
+    default_buckets,
+    pad_batch_to_multiple,
+    pad_to_bucket,
+    rebatch,
+)
+from sparkdl_tpu.runtime.prefetch import pipelined_map, prefetch_to_device
+
+__all__ = [
+    "AXIS_ORDER",
+    "DtypePolicy",
+    "FLOAT32",
+    "MeshSpec",
+    "PaddedBatch",
+    "batch_sharding",
+    "data_parallel_mesh",
+    "default_buckets",
+    "default_policy",
+    "pad_batch_to_multiple",
+    "pad_to_bucket",
+    "pipelined_map",
+    "prefetch_to_device",
+    "rebatch",
+    "replicated_sharding",
+    "single_device_mesh",
+]

@@ -1,0 +1,99 @@
+"""Bucketed batching: static shapes for XLA from ragged DataFrame partitions.
+
+Spark partitions are ragged; the reference simply runs ``Session.run`` on
+whatever block size TensorFrames hands it (SURVEY.md 3.1), which is fine for
+TF's dynamic shapes but would trigger one XLA recompile per distinct batch
+size on TPU. We instead pad every batch up to a small set of bucket sizes so
+each jitted executable is compiled at most once per bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Iterator, Sequence
+
+import numpy as np
+
+
+def default_buckets(max_batch: int, min_bucket: int = 8) -> tuple[int, ...]:
+    """Powers of two from min_bucket up to max_batch (inclusive)."""
+    buckets = []
+    b = min_bucket
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch)
+    return tuple(buckets)
+
+
+@dataclasses.dataclass(frozen=True)
+class PaddedBatch:
+    """A batch padded up to a bucket size.
+
+    ``arrays`` leading dims equal ``bucket``; rows ``[n_valid:]`` are padding
+    (repeats of row 0 so they are numerically harmless) and must be dropped
+    from the output.
+    """
+
+    arrays: dict[str, np.ndarray]
+    n_valid: int
+    bucket: int
+
+    def unpad(self, out: np.ndarray) -> np.ndarray:
+        return out[: self.n_valid]
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"batch of {n} rows exceeds largest bucket {buckets[-1]}")
+
+
+def pad_to_bucket(arrays: dict[str, np.ndarray], buckets: Sequence[int]) -> PaddedBatch:
+    n = next(iter(arrays.values())).shape[0]
+    if n == 0:
+        raise ValueError("cannot pad an empty batch (no row to repeat)")
+    bucket = pick_bucket(n, buckets)
+    if bucket == n:
+        return PaddedBatch(arrays, n, bucket)
+    padded = {}
+    for k, a in arrays.items():
+        pad_rows = np.repeat(a[:1], bucket - n, axis=0)
+        padded[k] = np.concatenate([a, pad_rows], axis=0)
+    return PaddedBatch(padded, n, bucket)
+
+
+def rebatch(
+    rows: Iterable[dict[str, np.ndarray]],
+    batch_size: int,
+    buckets: Sequence[int] | None = None,
+) -> Iterator[PaddedBatch]:
+    """Group per-row dicts into padded batches of at most ``batch_size``.
+
+    Full batches come out at exactly ``batch_size`` (one compile); the ragged
+    tail is padded up to the nearest bucket.
+    """
+    if buckets is None:
+        buckets = default_buckets(batch_size)
+    pending: list[dict[str, np.ndarray]] = []
+    for row in rows:
+        pending.append(row)
+        if len(pending) == batch_size:
+            yield _stack(pending, buckets)
+            pending = []
+    if pending:
+        yield _stack(pending, buckets)
+
+
+def _stack(rows: list[dict[str, np.ndarray]], buckets: Sequence[int]) -> PaddedBatch:
+    keys = rows[0].keys()
+    arrays = {k: np.stack([r[k] for r in rows], axis=0) for k in keys}
+    return pad_to_bucket(arrays, buckets)
+
+
+def pad_batch_to_multiple(arrays: dict[str, np.ndarray], multiple: int) -> PaddedBatch:
+    """Pad so the leading dim divides ``multiple`` (for sharded batch dims)."""
+    n = next(iter(arrays.values())).shape[0]
+    bucket = ((n + multiple - 1) // multiple) * multiple
+    return pad_to_bucket(arrays, [bucket])

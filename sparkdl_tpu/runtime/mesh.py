@@ -1,0 +1,107 @@
+"""Device-mesh discovery and construction.
+
+The reference has no device mesh: its inference parallelism is one TF
+session per Spark executor and its training parallelism is a Horovod ring
+(SURVEY.md 2.11/2.13). The TPU-native equivalent is a named
+``jax.sharding.Mesh`` over which pjit/shard_map place collectives on ICI.
+This module owns mesh axis conventions for the whole framework:
+
+  axis name | meaning
+  ----------+----------------------------------------------
+  ``dp``    | data parallel (batch split; psum of grads)
+  ``fsdp``  | fully-sharded data parallel (param shard over dp peers)
+  ``tp``    | tensor parallel (weight-column/row split)
+  ``sp``    | sequence/context parallel (ring attention)
+  ``pp``    | pipeline parallel (stage split)
+  ``ep``    | expert parallel (MoE expert split)
+
+Every model/transform in the framework refers to these names, never to raw
+device indices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: Canonical axis ordering. dp outermost (DCN-friendly), then pp, fsdp, sp,
+#: tp/ep innermost (highest-bandwidth ICI neighbours).
+AXIS_ORDER = ("dp", "pp", "fsdp", "sp", "tp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism layout, independent of physical device count.
+
+    A size of 1 means the axis is inert (present in the mesh so that
+    PartitionSpecs mentioning it always resolve, but no actual splitting).
+    Sizes of -1 (at most one) are inferred from the device count.
+    """
+
+    dp: int = -1
+    pp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    def sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        """Fill in the single -1 axis from n_devices; validate the product."""
+        sizes = self.sizes()
+        unknown = [a for a, s in sizes.items() if s == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"MeshSpec has more than one -1 axis: {unknown}")
+        known = math.prod(s for s in sizes.values() if s != -1)
+        if unknown:
+            if n_devices % known != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {known}"
+                )
+            sizes[unknown[0]] = n_devices // known
+        elif known != n_devices:
+            raise ValueError(
+                f"MeshSpec product {known} != device count {n_devices}"
+            )
+        return sizes
+
+    def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
+        if devices is None:
+            devices = jax.devices()
+        sizes = self.resolve(len(devices))
+        shape = tuple(sizes[a] for a in AXIS_ORDER)
+        arr = np.asarray(devices, dtype=object).reshape(shape)
+        return Mesh(arr, AXIS_ORDER)
+
+
+def data_parallel_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """All devices on the ``dp`` axis — the reference-parity layout
+
+    (its only parallelism is DP; SURVEY.md 2.11)."""
+    return MeshSpec(dp=-1).build(devices)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    if device is None:
+        device = jax.devices()[0]
+    return MeshSpec(dp=1).build([device])
+
+
+def batch_sharding(mesh: Mesh, batch_axes: Sequence[str] = ("dp", "fsdp")) -> NamedSharding:
+    """Sharding that splits the leading (batch) dim over the data axes."""
+    return NamedSharding(mesh, P(tuple(batch_axes)))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]

@@ -1,0 +1,94 @@
+"""Double-buffered host→device prefetch.
+
+TPU-side equivalent of the reference's TensorFrames block feed (SURVEY.md
+2.15): while the chip computes batch i, the host stages batch i+1. The C++
+Arrow bridge (sparkdl_tpu/bridge) accelerates the host-side staging when
+built; this module provides the scheduling either way.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, TypeVar
+
+import jax
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+_SENTINEL = object()
+
+
+def prefetch_to_device(
+    it: Iterable[T],
+    size: int = 2,
+    transfer: Callable[[T], U] | None = None,
+) -> Iterator[U]:
+    """Run ``transfer`` (default jax.device_put) on a background thread,
+    keeping ``size`` batches in flight ahead of the consumer.
+
+    device_put is async — it returns as soon as the DMA is enqueued — so a
+    depth-2 pipeline is enough to hide host→HBM transfer behind compute.
+    """
+    if transfer is None:
+        transfer = jax.device_put
+    q: queue.Queue = queue.Queue(maxsize=size)
+    err: list[BaseException] = []
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        # Bounded put so an abandoned consumer (generator closed early)
+        # releases the producer instead of leaking the thread and the
+        # device buffers queued behind it.
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in it:
+                if not put(transfer(item)):
+                    return
+        except BaseException as e:  # propagate into consumer
+            err.append(e)
+        finally:
+            put(_SENTINEL)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()
+        # Drain so a producer blocked mid-put can observe stop and exit.
+        while not q.empty():
+            try:
+                q.get_nowait()
+            except queue.Empty:  # pragma: no cover
+                break
+
+
+def pipelined_map(
+    fn: Callable[[U], T],
+    it: Iterable[U],
+    prefetch: int = 2,
+    transfer: Callable | None = None,
+) -> Iterator[T]:
+    """Map a (jitted) fn over batches with transfer/compute overlap.
+
+    Because jitted calls are async, simply iterating keeps the device busy;
+    the prefetch thread keeps the host side ahead.
+    """
+    for batch in prefetch_to_device(it, size=prefetch, transfer=transfer):
+        yield fn(batch)

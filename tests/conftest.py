@@ -1,0 +1,44 @@
+"""Test harness configuration.
+
+Reference-parity test strategy (SURVEY.md §4): the reference tests on
+``local[*]`` Spark; we test on a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``) so every DP/TP/SP collective
+path is unit-testable without TPU hardware. Must run before jax initializes
+a backend, hence top of conftest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep TF (used only for ingestion tests) off any accelerator and quiet.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+
+# The dev image's sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS pointing at the TPU, so the env var above is already stale —
+# override through jax.config before any backend is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def eight_device_mesh():
+    import jax
+    from sparkdl_tpu.runtime.mesh import data_parallel_mesh
+
+    assert len(jax.devices()) == 8, "conftest must set up 8 fake CPU devices"
+    return data_parallel_mesh()

@@ -1,0 +1,68 @@
+"""LocalDataFrame + adapter tests."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.dataframe import LocalDataFrame, columns_of, transform_partitions
+
+
+def _double(rows):
+    for r in rows:
+        r = dict(r)
+        r["y"] = r["x"] * 2
+        yield r
+
+
+class TestLocalDataFrame:
+    def test_partitioning(self):
+        df = LocalDataFrame.from_rows([{"x": i} for i in range(10)], 3)
+        assert df.num_partitions == 3
+        assert df.count() == 10
+        assert [r["x"] for r in df.collect()] == list(range(10))
+
+    def test_select_drop_rename(self):
+        df = LocalDataFrame.from_rows([{"a": 1, "b": 2}])
+        assert df.select("a").columns == ["a"]
+        assert df.drop("a").columns == ["b"]
+        assert df.withColumnRenamed("a", "z").columns == ["z", "b"]
+
+    def test_map_partitions_preserves_partitioning(self):
+        df = LocalDataFrame.from_rows([{"x": i} for i in range(7)], 2)
+        out = df.mapPartitions(_double)
+        assert out.num_partitions == 2
+        assert [r["y"] for r in out.collect()] == [2 * i for i in range(7)]
+
+    def test_row_attribute_access(self):
+        df = LocalDataFrame.from_rows([{"x": 5}])
+        assert df.first().x == 5
+
+    def test_to_pandas(self):
+        df = LocalDataFrame.from_rows([{"x": 1}, {"x": 2}])
+        pdf = df.toPandas()
+        assert list(pdf["x"]) == [1, 2]
+
+
+class TestAdapters:
+    def test_local(self):
+        df = LocalDataFrame.from_rows([{"x": 1}], 1)
+        out = transform_partitions(df, _double)
+        assert out.first()["y"] == 2
+
+    def test_pandas(self):
+        pdf = pd.DataFrame({"x": [1, 2, 3]})
+        out = transform_partitions(pdf, _double)
+        assert isinstance(out, pd.DataFrame)
+        assert list(out["y"]) == [2, 4, 6]
+
+    def test_arrow(self):
+        t = pa.table({"x": [1, 2]})
+        out = transform_partitions(t, _double)
+        assert isinstance(out, pa.Table)
+        assert out.column("y").to_pylist() == [2, 4]
+
+    def test_columns_of(self):
+        assert columns_of(pd.DataFrame({"a": [1]})) == ["a"]
+        assert columns_of(pa.table({"b": [1]})) == ["b"]
+        assert columns_of(LocalDataFrame.from_rows([{"c": 1}])) == ["c"]

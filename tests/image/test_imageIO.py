@@ -1,0 +1,117 @@
+"""imageIO tests — reference-parity behaviors (SURVEY.md §4, 2.8)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from sparkdl_tpu.image import (
+    OCV_BY_NAME,
+    UNDEFINED_MODE,
+    imageIO,
+)
+from sparkdl_tpu.image.imageIO import (
+    PIL_decode_bytes,
+    bgr_to_rgb,
+    imageArrayToStruct,
+    imageArrayToStructBGR,
+    imageStructToArray,
+    readImagesWithCustomFn,
+    rgb_to_bgr,
+)
+
+
+def _rand_img(rng, h=7, w=5, c=3, dtype=np.uint8):
+    if dtype == np.uint8:
+        return rng.integers(0, 256, size=(h, w, c), dtype=np.uint8)
+    return rng.random(size=(h, w, c), dtype=np.float32)
+
+
+class TestRoundTrip:
+    def test_uint8_rgb(self, rng):
+        arr = _rand_img(rng)
+        st = imageArrayToStruct(arr, origin="mem")
+        assert st["mode"] == OCV_BY_NAME["CV_8UC3"].mode
+        assert st["height"] == 7 and st["width"] == 5 and st["nChannels"] == 3
+        np.testing.assert_array_equal(imageStructToArray(st), arr)
+
+    def test_float32(self, rng):
+        arr = _rand_img(rng, dtype=np.float32)
+        st = imageArrayToStruct(arr)
+        assert st["mode"] == OCV_BY_NAME["CV_32FC3"].mode
+        np.testing.assert_array_equal(imageStructToArray(st), arr)
+
+    def test_grayscale_2d(self, rng):
+        arr = rng.integers(0, 256, size=(4, 6), dtype=np.uint8)
+        st = imageArrayToStruct(arr)
+        assert st["nChannels"] == 1
+        np.testing.assert_array_equal(imageStructToArray(st)[:, :, 0], arr)
+
+    def test_four_channel(self, rng):
+        arr = _rand_img(rng, c=4)
+        st = imageArrayToStruct(arr)
+        assert st["mode"] == OCV_BY_NAME["CV_8UC4"].mode
+        np.testing.assert_array_equal(imageStructToArray(st), arr)
+
+    def test_int64_coerced(self, rng):
+        arr = rng.integers(0, 256, size=(3, 3, 3)).astype(np.int64)
+        st = imageArrayToStruct(arr)
+        assert imageStructToArray(st).dtype == np.uint8
+
+
+class TestChannelOrder:
+    def test_bgr_flip_involution(self, rng):
+        arr = _rand_img(rng)
+        np.testing.assert_array_equal(bgr_to_rgb(rgb_to_bgr(arr)), arr)
+
+    def test_bgr_struct_stores_flipped(self, rng):
+        arr = _rand_img(rng)
+        st = imageArrayToStructBGR(arr)
+        np.testing.assert_array_equal(imageStructToArray(st), arr[..., ::-1])
+
+    def test_four_channel_keeps_alpha_last(self, rng):
+        arr = _rand_img(rng, c=4)
+        flipped = rgb_to_bgr(arr)
+        np.testing.assert_array_equal(flipped[..., 3], arr[..., 3])
+        np.testing.assert_array_equal(flipped[..., :3], arr[..., 2::-1])
+
+
+class TestDecode:
+    def test_pil_decode_png(self, rng):
+        arr = _rand_img(rng, h=9, w=11)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        st = PIL_decode_bytes(buf.getvalue(), origin="x.png")
+        # struct is BGR; flipping back recovers the lossless PNG content
+        np.testing.assert_array_equal(imageStructToArray(st)[..., ::-1], arr)
+        assert st["origin"] == "x.png"
+
+    def test_pil_decode_garbage_is_none(self):
+        assert PIL_decode_bytes(b"not an image") is None
+
+
+class TestReadImages:
+    def test_read_dir(self, tmp_path, rng):
+        for i in range(3):
+            arr = _rand_img(rng, h=8, w=8)
+            Image.fromarray(arr).save(tmp_path / f"img{i}.png")
+        (tmp_path / "junk.txt").write_bytes(b"hello")
+        df = readImagesWithCustomFn(str(tmp_path), numPartition=2)
+        rows = df.collect()
+        assert len(rows) == 4
+        modes = sorted(r["image"]["mode"] for r in rows)
+        assert modes.count(UNDEFINED_MODE) == 1  # junk.txt kept as undefined
+        assert df.num_partitions == 2
+
+    def test_custom_decoder(self, tmp_path):
+        (tmp_path / "a.bin").write_bytes(b"\x01\x02\x03\x04")
+
+        def decode(raw):
+            return np.frombuffer(raw, dtype=np.uint8).reshape(2, 2, 1)
+
+        df = readImagesWithCustomFn([str(tmp_path / "a.bin")], decode_f=decode)
+        row = df.first()
+        assert row["image"]["height"] == 2
+        assert row["image"]["origin"].endswith("a.bin")

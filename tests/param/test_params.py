@@ -1,0 +1,125 @@
+"""Param system tests — pyspark.ml.param-compatible semantics (SURVEY.md 2.19)."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.param import (
+    Estimator,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    Pipeline,
+    SparkDLTypeConverters as C,
+    Transformer,
+)
+
+
+class Doubler(Transformer, HasInputCol, HasOutputCol):
+    factor = Param(None, "factor", "multiplier", C.toFloat)
+
+    def __init__(self, inputCol=None, outputCol=None, factor=None):
+        super().__init__()
+        self._setDefault(factor=2.0)
+        self._set(inputCol=inputCol, outputCol=outputCol, factor=factor)
+
+    def _transform(self, dataset):
+        k = self.getOrDefault(self.factor)
+        ic, oc = self.getInputCol(), self.getOutputCol()
+
+        def fn(rows):
+            for r in rows:
+                r = dict(r)
+                r[oc] = r[ic] * k
+                yield r
+
+        return dataset.mapPartitions(fn)
+
+
+class MeanEstimator(Estimator, HasInputCol):
+    def _fit(self, dataset):
+        vals = [r[self.getInputCol()] for r in dataset.collect()]
+        m = float(np.mean(vals))
+        return Doubler(inputCol=self.getInputCol(), outputCol="scaled", factor=m)
+
+
+class TestParams:
+    def test_defaults_and_set(self):
+        d = Doubler(inputCol="x", outputCol="y")
+        assert d.getOrDefault("factor") == 2.0
+        d.set("factor", 3)
+        assert d.getOrDefault(d.factor) == 3.0
+
+    def test_type_converter_rejects(self):
+        with pytest.raises(TypeError):
+            Doubler(inputCol="x", outputCol="y", factor="nope")
+
+    def test_instances_do_not_share_state(self):
+        a = Doubler(inputCol="x", outputCol="y", factor=5)
+        b = Doubler(inputCol="x", outputCol="y")
+        assert b.getOrDefault("factor") == 2.0
+        assert a.getOrDefault("factor") == 5.0
+
+    def test_copy_with_extra(self):
+        a = Doubler(inputCol="x", outputCol="y")
+        b = a.copy({a.factor: 7})
+        assert b.getOrDefault("factor") == 7.0
+        assert a.getOrDefault("factor") == 2.0
+
+    def test_extract_param_map(self):
+        a = Doubler(inputCol="x", outputCol="y", factor=4)
+        m = a.extractParamMap()
+        assert {p.name: v for p, v in m.items()}["factor"] == 4.0
+
+    def test_explain_params(self):
+        text = Doubler(inputCol="x", outputCol="y").explainParams()
+        assert "factor: multiplier" in text
+
+    def test_transform_with_param_override(self):
+        from sparkdl_tpu.dataframe import LocalDataFrame
+
+        df = LocalDataFrame.from_rows([{"x": 1.0}, {"x": 2.0}], 2)
+        d = Doubler(inputCol="x", outputCol="y")
+        out = d.transform(df, {d.factor: 10})
+        assert [r["y"] for r in out.collect()] == [10.0, 20.0]
+        # original untouched
+        assert d.getOrDefault("factor") == 2.0
+
+
+class TestPipeline:
+    def test_fit_transform_chain(self):
+        from sparkdl_tpu.dataframe import LocalDataFrame
+
+        df = LocalDataFrame.from_rows([{"x": 1.0}, {"x": 3.0}])
+        pipe = Pipeline([MeanEstimator()._set(inputCol="x")])
+        model = pipe.fit(df)
+        out = model.transform(df)
+        assert [r["scaled"] for r in out.collect()] == [2.0, 6.0]
+
+    def test_fit_multiple_param_maps(self):
+        from sparkdl_tpu.dataframe import LocalDataFrame
+
+        df = LocalDataFrame.from_rows([{"x": 1.0}])
+        est = MeanEstimator()._set(inputCol="x")
+        models = est.fit(df, [{}, {}])
+        assert len(models) == 2
+
+
+class TestConverters:
+    def test_existing_file(self, tmp_path):
+        p = tmp_path / "m.h5"
+        p.write_bytes(b"")
+        assert C.toExistingFilePath(str(p)) == str(p)
+        with pytest.raises(ValueError):
+            C.toExistingFilePath(str(tmp_path / "missing.h5"))
+
+    def test_str_str_map(self):
+        assert C.toColumnToTensorNameMap({"a": "b"}) == {"a": "b"}
+        with pytest.raises(TypeError):
+            C.toColumnToTensorNameMap({"a": 1})
+        with pytest.raises(TypeError):
+            C.toColumnToTensorNameMap({})
+
+    def test_channel_order(self):
+        assert C.toChannelOrder("BGR") == "BGR"
+        with pytest.raises(ValueError):
+            C.toChannelOrder("BRG")

@@ -1,0 +1,133 @@
+"""Runtime-layer tests: mesh construction, bucketing, prefetch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.runtime import (
+    MeshSpec,
+    PaddedBatch,
+    data_parallel_mesh,
+    default_buckets,
+    pad_batch_to_multiple,
+    pad_to_bucket,
+    pipelined_map,
+    prefetch_to_device,
+    rebatch,
+)
+from sparkdl_tpu.runtime.mesh import AXIS_ORDER, batch_sharding
+
+
+class TestMesh:
+    def test_dp_mesh_uses_all_devices(self):
+        mesh = data_parallel_mesh()
+        assert mesh.shape["dp"] == 8
+        assert set(mesh.axis_names) == set(AXIS_ORDER)
+
+    def test_spec_infers_minus_one(self):
+        sizes = MeshSpec(dp=-1, tp=2).resolve(8)
+        assert sizes["dp"] == 4 and sizes["tp"] == 2
+
+    def test_spec_rejects_bad_product(self):
+        with pytest.raises(ValueError):
+            MeshSpec(dp=3, tp=2).resolve(8)
+
+    def test_dp_tp_mesh_builds(self):
+        mesh = MeshSpec(dp=2, tp=4).build()
+        assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 4
+
+    def test_batch_sharding_places_rows(self):
+        mesh = data_parallel_mesh()
+        x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+        y = jax.device_put(x, batch_sharding(mesh))
+        assert len(y.sharding.device_set) == 8
+
+
+class TestBuckets:
+    def test_default_buckets(self):
+        assert default_buckets(64) == (8, 16, 32, 64)
+        assert default_buckets(100) == (8, 16, 32, 64, 100)
+
+    def test_pad_exact(self):
+        b = pad_to_bucket({"x": np.ones((16, 2))}, (8, 16))
+        assert b.bucket == 16 and b.n_valid == 16
+
+    def test_pad_ragged(self):
+        b = pad_to_bucket({"x": np.arange(10).reshape(5, 2)}, (8, 16))
+        assert b.bucket == 8 and b.n_valid == 5
+        assert b.arrays["x"].shape == (8, 2)
+        # padding repeats row 0
+        np.testing.assert_array_equal(b.arrays["x"][5], b.arrays["x"][0])
+
+    def test_unpad(self):
+        b = pad_to_bucket({"x": np.ones((5, 2))}, (8,))
+        out = np.arange(16).reshape(8, 2)
+        np.testing.assert_array_equal(b.unpad(out), out[:5])
+
+    def test_rebatch_counts(self):
+        rows = [{"x": np.full((3,), i)} for i in range(21)]
+        batches = list(rebatch(iter(rows), batch_size=8))
+        assert [b.n_valid for b in batches] == [8, 8, 5]
+        assert [b.bucket for b in batches] == [8, 8, 8]
+        # row values preserved in order
+        flat = np.concatenate([b.unpad(b.arrays["x"]) for b in batches])
+        np.testing.assert_array_equal(flat[:, 0], np.arange(21))
+
+    def test_pad_to_multiple(self):
+        b = pad_batch_to_multiple({"x": np.ones((10, 2))}, 8)
+        assert b.arrays["x"].shape[0] == 16 and b.n_valid == 10
+
+    def test_oversize_batch_rejected(self):
+        with pytest.raises(ValueError, match="exceeds largest bucket"):
+            pad_to_bucket({"x": np.ones((20, 2))}, (8, 16))
+
+    def test_empty_batch_rejected(self):
+        with pytest.raises(ValueError, match="empty batch"):
+            pad_to_bucket({"x": np.ones((0, 2))}, (8,))
+
+
+class TestPrefetch:
+    def test_prefetch_order_and_content(self):
+        batches = [np.full((4,), i, dtype=np.float32) for i in range(10)]
+        out = list(prefetch_to_device(iter(batches), size=2))
+        assert len(out) == 10
+        for i, o in enumerate(out):
+            np.testing.assert_array_equal(np.asarray(o), batches[i])
+
+    def test_prefetch_propagates_errors(self):
+        def gen():
+            yield np.ones((2,))
+            raise RuntimeError("boom")
+
+        it = prefetch_to_device(gen(), size=2)
+        next(it)
+        with pytest.raises(RuntimeError, match="boom"):
+            list(it)
+
+    def test_pipelined_map(self):
+        f = jax.jit(lambda x: x * 2)
+        batches = [np.full((4,), i, dtype=np.float32) for i in range(5)]
+        out = [np.asarray(o) for o in pipelined_map(f, iter(batches))]
+        np.testing.assert_array_equal(out[3], np.full((4,), 6.0))
+
+    def test_abandoned_consumer_releases_producer(self):
+        import threading
+        import time
+
+        produced = []
+
+        def gen():
+            for i in range(100):
+                produced.append(i)
+                yield np.full((2,), i, dtype=np.float32)
+
+        it = prefetch_to_device(gen(), size=2)
+        next(it)
+        it.close()  # consumer walks away
+        deadline = time.time() + 5
+        while time.time() < deadline and threading.active_count() > 10:
+            time.sleep(0.05)
+        # producer must have stopped early, not drained all 100 items
+        time.sleep(0.3)
+        assert len(produced) < 100
