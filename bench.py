@@ -1,0 +1,82 @@
+"""Headline benchmark: InceptionV3 featurization throughput (images/sec/chip).
+
+Driver contract: prints exactly ONE JSON line
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline is against the 10,000 images/sec/chip target from BASELINE.md
+(the reference publishes no numbers of its own).
+
+Runs on whatever the default JAX platform is (the real TPU chip under the
+driver; CPU elsewhere). Measures the steady-state jitted hot loop —
+on-device uint8 -> preprocess -> bf16 InceptionV3 features — with the batch
+device-resident. (In this sandbox the chip sits behind a relay whose
+host->device path is ~18 MB/s, so a host-fed pipeline would measure the
+tunnel, not the framework; on a real TPU host the C++ infeed bridge feeds
+this same loop.)
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.models.registry import build_flax_model, get_entry
+    from sparkdl_tpu.ops.preprocess import PREPROCESSORS
+
+    platform = jax.default_backend()
+    on_accel = platform not in ("cpu",)
+    batch = int(os.environ.get("BENCH_BATCH", 128 if on_accel else 8))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_accel else 3))
+    size = 299 if on_accel else 128  # CPU smoke keeps compile/runtime sane
+
+    entry = get_entry("InceptionV3")
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
+    module, variables = build_flax_model(
+        "InceptionV3", weights=None, include_top=False, dtype=dtype
+    )
+    preprocess = PREPROCESSORS[entry.preprocess]
+
+    @jax.jit
+    def featurize(x):
+        feats, _ = module.apply(
+            variables, preprocess(x.astype(dtype)), train=False
+        )
+        return feats.astype(jnp.float32)
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(
+        rng.integers(0, 256, (batch, size, size, 3), dtype=np.uint8)
+    )
+
+    # warmup / compile
+    featurize(x).block_until_ready()
+
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(steps):
+        last = featurize(x)
+    last.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * steps / dt
+    target = 10_000.0
+    print(
+        json.dumps(
+            {
+                "metric": f"InceptionV3 featurization images/sec/chip "
+                          f"({platform}, {size}px, batch {batch})",
+                "value": round(images_per_sec, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(images_per_sec / target, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -6,6 +6,14 @@ re-implemented on JAX/XLA for TPU. Imports are lazy so that lightweight uses
 (image IO, params) do not pull in flax/TF.
 """
 
+import os as _os
+
+# Keras models loaded by this framework should execute natively on JAX so
+# they jit/shard like everything else (Keras 3 multi-backend). Must be set
+# before the first `import keras` anywhere in the process; users can
+# override by exporting KERAS_BACKEND themselves.
+_os.environ.setdefault("KERAS_BACKEND", "jax")
+
 from sparkdl_tpu.version import __version__
 
 _LAZY = {

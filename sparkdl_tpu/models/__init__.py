@@ -1,0 +1,17 @@
+from sparkdl_tpu.models.registry import (
+    SUPPORTED_MODELS,
+    ModelEntry,
+    build_flax_model,
+    build_keras_model,
+    get_entry,
+    registry,
+)
+
+__all__ = [
+    "SUPPORTED_MODELS",
+    "ModelEntry",
+    "build_flax_model",
+    "build_keras_model",
+    "get_entry",
+    "registry",
+]

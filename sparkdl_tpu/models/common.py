@@ -1,0 +1,135 @@
+"""Shared building blocks for the Flax named-model zoo.
+
+The reference ships frozen TF GraphDefs per named model (SURVEY.md 2.1/2.2);
+we ship hand-written Flax modules instead. Every weight-bearing layer is
+named by construction order (``conv000``, ``bn000``, ``dense000``,
+``sepdw000``/``seppw000``) via :class:`Namer`; the Keras→Flax weight
+converter (models/keras_loader.py) replays the same ordering over a Keras
+model's layers, so conversion is a mechanical per-type zip with no
+name-table per architecture.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Namer:
+    """Construction-order names for weight-bearing layers."""
+
+    def __init__(self) -> None:
+        self._counts: dict[str, int] = {}
+
+    def next(self, kind: str) -> str:
+        i = self._counts.get(kind, 0)
+        self._counts[kind] = i + 1
+        return f"{kind}{i:03d}"
+
+    def conv(self) -> str:
+        return self.next("conv")
+
+    def bn(self) -> str:
+        return self.next("bn")
+
+    def dense(self) -> str:
+        return self.next("dense")
+
+    def sepdw(self) -> str:
+        return self.next("sepdw")
+
+    def seppw(self) -> str:
+        return self.next("seppw")
+
+
+class ZooModule(nn.Module):
+    """Base for zoo models: dtype policy fields + layer helpers.
+
+    ``dtype`` is the compute dtype (bfloat16 on TPU); params stay float32.
+    """
+
+    num_classes: int = 1000
+    include_top: bool = True
+    dtype: Any = jnp.float32
+
+    def _conv(self, nm: Namer, x, features: int, kernel: int | tuple[int, int],
+              strides: int = 1, padding: str = "SAME", use_bias: bool = True):
+        if isinstance(kernel, int):
+            kernel = (kernel, kernel)
+        return nn.Conv(
+            features,
+            kernel,
+            strides=(strides, strides),
+            padding=padding,
+            use_bias=use_bias,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name=nm.conv(),
+        )(x)
+
+    def _bn(self, nm: Namer, x, train: bool, use_scale: bool = True,
+            epsilon: float = 1e-3, momentum: float = 0.99):
+        return nn.BatchNorm(
+            use_running_average=not train,
+            momentum=momentum,
+            epsilon=epsilon,
+            use_scale=use_scale,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name=nm.bn(),
+        )(x)
+
+    def _dense(self, nm: Namer, x, features: int):
+        return nn.Dense(
+            features, dtype=self.dtype, param_dtype=jnp.float32, name=nm.dense()
+        )(x)
+
+    def _sepconv(self, nm: Namer, x, features: int, kernel: int = 3,
+                 strides: int = 1, padding: str = "SAME", use_bias: bool = False):
+        """SeparableConv2D = depthwise conv + pointwise 1x1 conv.
+
+        Kept as two convs (XLA fuses the pointwise into the following op);
+        names pair up with the single Keras SeparableConv2D layer.
+        """
+        in_ch = x.shape[-1]
+        x = nn.Conv(
+            in_ch,
+            (kernel, kernel),
+            strides=(strides, strides),
+            padding=padding,
+            feature_group_count=in_ch,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name=nm.sepdw(),
+        )(x)
+        return nn.Conv(
+            features,
+            (1, 1),
+            use_bias=use_bias,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name=nm.seppw(),
+        )(x)
+
+
+def max_pool(x, window: int = 3, strides: int = 2, padding: str = "VALID"):
+    return nn.max_pool(x, (window, window), (strides, strides), padding)
+
+
+def avg_pool_keras(x, window: int = 3, strides: int = 1, padding: str = "SAME"):
+    """Average pool matching Keras semantics: padded cells are excluded from
+    the divisor (count_include_pad=False)."""
+    return nn.avg_pool(
+        x, (window, window), (strides, strides), padding, count_include_pad=False
+    )
+
+
+def global_avg_pool(x):
+    return jnp.mean(x, axis=(1, 2))
+
+
+def zero_pad(x, pad: int):
+    return jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))

@@ -1,0 +1,117 @@
+"""InceptionV3 in Flax (keras.applications.inception_v3-equivalent).
+
+The reference's flagship featurizer model — its north-star benchmark is
+InceptionV3 featurization throughput (BASELINE.md). Every conv is
+bias-free and every BN is gamma-free (scale=False), per the Keras original.
+Branch construction order inside each mixed block follows Keras so that
+order-based weight conversion lines up; concatenation order ==
+construction order.
+
+features = global-average-pooled mixed10 output (2048-d).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.common import (
+    Namer,
+    ZooModule,
+    avg_pool_keras,
+    global_avg_pool,
+    max_pool,
+)
+
+
+class InceptionV3(ZooModule):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        nm = Namer()
+
+        def cb(x, filters, kh, kw, strides=1, padding="SAME"):
+            x = self._conv(
+                nm, x, filters, (kh, kw), strides=strides, padding=padding,
+                use_bias=False,
+            )
+            x = self._bn(nm, x, train, use_scale=False)
+            return nn.relu(x)
+
+        def concat(*branches):
+            return jnp.concatenate(branches, axis=-1)
+
+        # -- stem ----------------------------------------------------------
+        x = cb(x, 32, 3, 3, strides=2, padding="VALID")
+        x = cb(x, 32, 3, 3, padding="VALID")
+        x = cb(x, 64, 3, 3)
+        x = max_pool(x, 3, 2, "VALID")
+        x = cb(x, 80, 1, 1, padding="VALID")
+        x = cb(x, 192, 3, 3, padding="VALID")
+        x = max_pool(x, 3, 2, "VALID")
+
+        # -- 3x inception-A (35x35), mixed0..2 -----------------------------
+        for pool_filters in (32, 64, 64):
+            b1 = cb(x, 64, 1, 1)
+            b5 = cb(x, 48, 1, 1)
+            b5 = cb(b5, 64, 5, 5)
+            b3 = cb(x, 64, 1, 1)
+            b3 = cb(b3, 96, 3, 3)
+            b3 = cb(b3, 96, 3, 3)
+            bp = avg_pool_keras(x, 3, 1, "SAME")
+            bp = cb(bp, pool_filters, 1, 1)
+            x = concat(b1, b5, b3, bp)
+
+        # -- reduction-A, mixed3 -------------------------------------------
+        b3 = cb(x, 384, 3, 3, strides=2, padding="VALID")
+        bd = cb(x, 64, 1, 1)
+        bd = cb(bd, 96, 3, 3)
+        bd = cb(bd, 96, 3, 3, strides=2, padding="VALID")
+        bp = max_pool(x, 3, 2, "VALID")
+        x = concat(b3, bd, bp)
+
+        # -- 4x inception-B (17x17), mixed4..7 -----------------------------
+        for mid in (128, 160, 160, 192):
+            b1 = cb(x, 192, 1, 1)
+            b7 = cb(x, mid, 1, 1)
+            b7 = cb(b7, mid, 1, 7)
+            b7 = cb(b7, 192, 7, 1)
+            bd = cb(x, mid, 1, 1)
+            bd = cb(bd, mid, 7, 1)
+            bd = cb(bd, mid, 1, 7)
+            bd = cb(bd, mid, 7, 1)
+            bd = cb(bd, 192, 1, 7)
+            bp = avg_pool_keras(x, 3, 1, "SAME")
+            bp = cb(bp, 192, 1, 1)
+            x = concat(b1, b7, bd, bp)
+
+        # -- reduction-B, mixed8 -------------------------------------------
+        b3 = cb(x, 192, 1, 1)
+        b3 = cb(b3, 320, 3, 3, strides=2, padding="VALID")
+        b7 = cb(x, 192, 1, 1)
+        b7 = cb(b7, 192, 1, 7)
+        b7 = cb(b7, 192, 7, 1)
+        b7 = cb(b7, 192, 3, 3, strides=2, padding="VALID")
+        bp = max_pool(x, 3, 2, "VALID")
+        x = concat(b3, b7, bp)
+
+        # -- 2x inception-C (8x8), mixed9..10 ------------------------------
+        for _ in range(2):
+            b1 = cb(x, 320, 1, 1)
+            b3 = cb(x, 384, 1, 1)
+            b3a = cb(b3, 384, 1, 3)
+            b3b = cb(b3, 384, 3, 1)
+            b3 = concat(b3a, b3b)
+            bd = cb(x, 448, 1, 1)
+            bd = cb(bd, 384, 3, 3)
+            bda = cb(bd, 384, 1, 3)
+            bdb = cb(bd, 384, 3, 1)
+            bd = concat(bda, bdb)
+            bp = avg_pool_keras(x, 3, 1, "SAME")
+            bp = cb(bp, 192, 1, 1)
+            x = concat(b1, b3, bd, bp)
+
+        features = global_avg_pool(x)
+        if not self.include_top:
+            return features, None
+        logits = self._dense(nm, features, self.num_classes)
+        return features, nn.softmax(logits)

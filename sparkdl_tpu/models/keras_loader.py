@@ -1,0 +1,170 @@
+"""Keras → Flax weight conversion.
+
+Replaces the reference's "load Keras HDF5, freeze to GraphDef" path
+(SURVEY.md 2.3/2.9): here Keras weights become a Flax variables pytree for
+the hand-written zoo modules. Matching is by construction order per layer
+type (see models/common.Namer): the k-th Keras Conv2D maps to ``conv{k:03d}``
+and so on — no per-architecture name tables. Layout notes:
+
+  Keras Conv2D kernel   (kh, kw, in, out)      == Flax Conv kernel
+  Keras Dense kernel    (in, out)              == Flax Dense kernel
+  Keras DepthwiseConv2D (kh, kw, in, mult)     -> transpose to (kh, kw, mult, in)
+  Keras SeparableConv2D depthwise + pointwise  -> sepdwNNN + seppwNNN pair
+  Keras BatchNormalization gamma/beta          -> params scale/bias
+                           moving mean/var     -> batch_stats mean/var
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import numpy as np
+
+from sparkdl_tpu.models.common import Namer
+
+
+_AUTO_SUFFIX = re.compile(r"^(.*?)(?:_(\d+))?$")
+
+
+def _auto_suffix_key(name: str) -> int:
+    """Keras auto-names ('conv2d', 'conv2d_7') carry construction order in
+    the suffix (global per-class counter, monotone within a model)."""
+    m = _AUTO_SUFFIX.match(name)
+    return int(m.group(2)) if m.group(2) else -1
+
+
+def keras_to_flax_variables(kmodel, layer_order: str = "topo") -> dict[str, Any]:
+    """Convert a Keras model's weights to a Flax variables dict
+    ``{'params': ..., 'batch_stats': ...}`` under Namer's naming scheme.
+
+    Because Namer counters are independent per layer type, only the
+    *per-type* ordering matters. ``layer_order`` picks it:
+
+      'topo'        — ``model.layers`` topological order (Keras's own
+                      deterministic serialization order). Zoo modules whose
+                      branches are written in this order (ResNet, VGG,
+                      Xception) use it.
+      'auto_suffix' — sort each type bucket by the auto-name numeric suffix,
+                      recovering true construction order. Needed for
+                      InceptionV3, whose parallel branches make topological
+                      order differ from the source construction order the
+                      Flax module mirrors.
+    """
+    import keras
+
+    # bucket weight-bearing layers by kind, preserving topological order
+    buckets: dict[str, list] = {"conv": [], "sep": [], "bn": [], "dense": []}
+    for lyr in kmodel.layers:
+        if isinstance(lyr, keras.layers.SeparableConv2D):
+            buckets["sep"].append(lyr)
+        elif isinstance(lyr, (keras.layers.Conv2D, keras.layers.DepthwiseConv2D)):
+            buckets["conv"].append(lyr)
+        elif isinstance(lyr, keras.layers.BatchNormalization):
+            buckets["bn"].append(lyr)
+        elif isinstance(lyr, keras.layers.Dense):
+            buckets["dense"].append(lyr)
+        elif lyr.get_weights():
+            raise ValueError(
+                f"unsupported weight-bearing layer {type(lyr).__name__} "
+                f"({lyr.name}); zoo conversion handles conv/bn/dense families"
+            )
+    if layer_order == "auto_suffix":
+        for b in buckets.values():
+            b.sort(key=lambda l: _auto_suffix_key(l.name))
+    elif layer_order != "topo":
+        raise ValueError(f"unknown layer_order {layer_order!r}")
+
+    params: dict[str, Any] = {}
+    stats: dict[str, Any] = {}
+    nm = Namer()
+    for lyr in buckets["conv"]:
+        w = [np.asarray(a) for a in lyr.get_weights()]
+        if isinstance(lyr, keras.layers.DepthwiseConv2D):
+            p: dict[str, Any] = {"kernel": w[0].transpose(0, 1, 3, 2)}
+        else:
+            p = {"kernel": w[0]}
+        if lyr.use_bias:
+            p["bias"] = w[1]
+        params[nm.conv()] = p
+    for lyr in buckets["sep"]:
+        w = [np.asarray(a) for a in lyr.get_weights()]
+        params[nm.sepdw()] = {"kernel": w[0].transpose(0, 1, 3, 2)}
+        p = {"kernel": w[1]}
+        if lyr.use_bias:
+            p["bias"] = w[2]
+        params[nm.seppw()] = p
+    for lyr in buckets["bn"]:
+        w = [np.asarray(a) for a in lyr.get_weights()]
+        i = 0
+        bn: dict[str, Any] = {}
+        if lyr.scale:
+            bn["scale"] = w[i]
+            i += 1
+        if lyr.center:
+            bn["bias"] = w[i]
+            i += 1
+        name = nm.bn()
+        params[name] = bn
+        stats[name] = {"mean": w[i], "var": w[i + 1]}
+    for lyr in buckets["dense"]:
+        w = [np.asarray(a) for a in lyr.get_weights()]
+        p = {"kernel": w[0]}
+        if lyr.use_bias:
+            p["bias"] = w[1]
+        params[nm.dense()] = p
+
+    out: dict[str, Any] = {"params": params}
+    if stats:
+        out["batch_stats"] = stats
+    return out
+
+
+def prune_to_structure(converted: dict, initialized: dict) -> dict:
+    """Drop converted entries the module does not define (e.g. the
+    classifier head when loading top-ful weights into include_top=False).
+    Missing entries still fail later in check_variables_match."""
+    out: dict[str, Any] = {}
+    for col, leaves in converted.items():
+        if col not in initialized:
+            continue
+        out[col] = {k: v for k, v in leaves.items() if k in initialized[col]}
+    return out
+
+
+def check_variables_match(converted: dict, initialized: dict) -> None:
+    """Raise with a readable diff if converted shapes/names disagree with a
+    module's init shapes — the oracle tests' first line of defense."""
+    import jax
+
+    conv_flat = {
+        "/".join(map(str, [getattr(k, "key", k) for k in path])): v.shape
+        for path, v in jax.tree_util.tree_flatten_with_path(converted)[0]
+    }
+    init_flat = {
+        "/".join(map(str, [getattr(k, "key", k) for k in path])): v.shape
+        for path, v in jax.tree_util.tree_flatten_with_path(initialized)[0]
+    }
+    missing = sorted(set(init_flat) - set(conv_flat))
+    extra = sorted(set(conv_flat) - set(init_flat))
+    mismatched = sorted(
+        k for k in set(conv_flat) & set(init_flat) if conv_flat[k] != init_flat[k]
+    )
+    if missing or extra or mismatched:
+        lines = []
+        for k in missing[:12]:
+            lines.append(f"  missing from conversion: {k} {init_flat[k]}")
+        for k in extra[:12]:
+            lines.append(f"  extra in conversion:     {k} {conv_flat[k]}")
+        for k in mismatched[:12]:
+            lines.append(
+                f"  shape mismatch: {k} converted {conv_flat[k]} vs init {init_flat[k]}"
+            )
+        raise ValueError("Keras->Flax conversion mismatch:\n" + "\n".join(lines))
+
+
+def load_keras_model_file(path: str):
+    """Load a Keras model from .h5 / .keras file (compile=False)."""
+    import keras
+
+    return keras.models.load_model(path, compile=False)

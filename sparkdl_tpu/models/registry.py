@@ -1,0 +1,150 @@
+"""Named-model registry: metadata + constructors for the pretrained zoo.
+
+Parity with the reference's per-model graph/metadata registry (SURVEY.md
+2.1): each entry records input size, preprocessing mode, featurization
+width, and how to build both the Flax module and the Keras original (for
+weight conversion and oracle tests). Weight resolution order:
+
+  1. explicit .h5/.keras file given by the caller,
+  2. keras.applications pretrained weights if cached locally
+     (zero-egress environments fall back to 3),
+  3. random init (weights=None) — architecture-only mode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelEntry:
+    name: str
+    flax_builder: Callable[..., Any]
+    keras_builder_path: str  # "module:attr" inside keras.applications
+    input_size: tuple[int, int]
+    preprocess: str  # key into sparkdl_tpu.ops.preprocess.PREPROCESSORS
+    feature_dim: int
+    num_classes: int = 1000
+    #: Keras models whose featurization layer needs the classifier head
+    #: built (VGG fc2), i.e. include_top must stay True even for features.
+    features_need_top: bool = False
+    #: per-type layer ordering for weight conversion (see keras_loader)
+    layer_order: str = "topo"
+
+
+def _entries() -> dict[str, ModelEntry]:
+    from sparkdl_tpu.models.inception import InceptionV3
+    from sparkdl_tpu.models.resnet import ResNet50
+    from sparkdl_tpu.models.vgg import VGG16, VGG19
+    from sparkdl_tpu.models.xception import Xception
+
+    entries = [
+        ModelEntry("InceptionV3", InceptionV3, "inception_v3:InceptionV3",
+                   (299, 299), "tf", 2048, layer_order="auto_suffix"),
+        ModelEntry("Xception", Xception, "xception:Xception",
+                   (299, 299), "tf", 2048),
+        ModelEntry("ResNet50", ResNet50, "resnet:ResNet50",
+                   (224, 224), "caffe", 2048),
+        ModelEntry("VGG16", VGG16, "vgg16:VGG16",
+                   (224, 224), "caffe", 4096, features_need_top=True),
+        ModelEntry("VGG19", VGG19, "vgg19:VGG19",
+                   (224, 224), "caffe", 4096, features_need_top=True),
+    ]
+    return {e.name: e for e in entries}
+
+
+_REGISTRY: dict[str, ModelEntry] | None = None
+
+
+def registry() -> dict[str, ModelEntry]:
+    global _REGISTRY
+    if _REGISTRY is None:
+        _REGISTRY = _entries()
+    return _REGISTRY
+
+
+SUPPORTED_MODELS = ("InceptionV3", "Xception", "ResNet50", "VGG16", "VGG19")
+
+
+def get_entry(name: str) -> ModelEntry:
+    reg = registry()
+    if name not in reg:
+        raise ValueError(
+            f"unknown model {name!r}; supported: {sorted(reg)}"
+        )
+    return reg[name]
+
+
+def build_keras_model(entry: ModelEntry, weights: str | None = "imagenet",
+                      include_top: bool = True):
+    """Build the keras.applications original (for conversion/oracles).
+
+    Falls back to random weights when pretrained ones are not cached and
+    cannot be downloaded (zero-egress), with a warning.
+    """
+    import importlib
+
+    mod_name, attr = entry.keras_builder_path.split(":")
+    mod = importlib.import_module(f"keras.applications.{mod_name}")
+    builder = getattr(mod, attr)
+    try:
+        return builder(weights=weights, include_top=include_top)
+    except Exception as e:
+        if weights is not None:
+            logger.warning(
+                "could not load %s pretrained weights (%s); using random init",
+                entry.name, e,
+            )
+            return builder(weights=None, include_top=include_top)
+        raise
+
+
+def build_flax_model(name: str, weights: "str | None" = "imagenet",
+                     dtype=None, include_top: bool = True):
+    """Return (module, variables) for a named model.
+
+    ``weights`` may be 'imagenet', a path to a Keras .h5/.keras file, or
+    None for random init.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.models.keras_loader import (
+        check_variables_match,
+        keras_to_flax_variables,
+        load_keras_model_file,
+        prune_to_structure,
+    )
+
+    entry = get_entry(name)
+    if dtype is None:
+        dtype = jnp.float32
+    ktop = include_top or entry.features_need_top
+    module = entry.flax_builder(
+        include_top=ktop, dtype=dtype, num_classes=entry.num_classes
+    )
+    if weights is None:
+        h, w = entry.input_size
+        variables = module.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, h, w, 3), jnp.float32)
+        )
+        return module, variables
+    if isinstance(weights, str) and weights != "imagenet":
+        kmodel = load_keras_model_file(weights)
+    else:
+        kmodel = build_keras_model(entry, weights=weights, include_top=ktop)
+    variables = keras_to_flax_variables(kmodel, layer_order=entry.layer_order)
+    h, w = entry.input_size
+    init_vars = jax.eval_shape(
+        lambda: module.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, h, w, 3), jnp.float32)
+        )
+    )
+    # weight files may carry a classifier head the module doesn't build
+    variables = prune_to_structure(variables, init_vars)
+    check_variables_match(variables, init_vars)
+    return module, variables

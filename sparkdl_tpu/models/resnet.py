@@ -1,0 +1,78 @@
+"""ResNet50 in Flax (keras.applications.resnet.ResNet50-equivalent).
+
+One of the reference's named models (SURVEY.md 2.1). Architecture is the
+original v1 bottleneck ResNet as Keras builds it: stride on the first 1x1
+conv of each stage's first block, conv biases on, BN epsilon 1.001e-5.
+Construction order mirrors Keras exactly so order-based weight conversion
+(models/keras_loader.py) lines up: shortcut conv/BN created before the
+block's main-path convs.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from sparkdl_tpu.models.common import (
+    Namer,
+    ZooModule,
+    global_avg_pool,
+    max_pool,
+    zero_pad,
+)
+
+_BN_EPS = 1.001e-5
+
+
+class ResNet50(ZooModule):
+    """Returns (features, logits); logits is None when include_top=False.
+
+    features = global-average-pooled penultimate activations (2048-d), the
+    featurization layer DeepImageFeaturizer exposes.
+    """
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        nm = Namer()
+
+        def conv_bn_relu_chainless(x):  # stem
+            x = zero_pad(x, 3)
+            x = self._conv(nm, x, 64, 7, strides=2, padding="VALID")
+            x = self._bn(nm, x, train, epsilon=_BN_EPS)
+            x = nn.relu(x)
+            x = zero_pad(x, 1)
+            return max_pool(x, 3, 2, "VALID")
+
+        def block(x, filters: int, stride: int = 1, conv_shortcut: bool = True):
+            # Layer order replays Keras's serialized topology order:
+            # 1_conv, 2_conv, 0_conv (shortcut), 3_conv — and BNs likewise.
+            y = self._conv(nm, x, filters, 1, strides=stride)
+            y = self._bn(nm, y, train, epsilon=_BN_EPS)
+            y = nn.relu(y)
+            y = self._conv(nm, y, filters, 3)
+            y = self._bn(nm, y, train, epsilon=_BN_EPS)
+            y = nn.relu(y)
+            if conv_shortcut:
+                sc = self._conv(nm, x, 4 * filters, 1, strides=stride)
+                sc = self._bn(nm, sc, train, epsilon=_BN_EPS)
+            else:
+                sc = x
+            y = self._conv(nm, y, 4 * filters, 1)
+            y = self._bn(nm, y, train, epsilon=_BN_EPS)
+            return nn.relu(y + sc)
+
+        def stack(x, filters: int, blocks: int, stride: int):
+            x = block(x, filters, stride=stride)
+            for _ in range(blocks - 1):
+                x = block(x, filters, conv_shortcut=False)
+            return x
+
+        x = conv_bn_relu_chainless(x)
+        x = stack(x, 64, 3, stride=1)
+        x = stack(x, 128, 4, stride=2)
+        x = stack(x, 256, 6, stride=2)
+        x = stack(x, 512, 3, stride=2)
+        features = global_avg_pool(x)
+        if not self.include_top:
+            return features, None
+        logits = self._dense(nm, features, self.num_classes)
+        return features, nn.softmax(logits)

@@ -1,0 +1,40 @@
+"""VGG16 / VGG19 in Flax (keras.applications.vgg16/vgg19-equivalent).
+
+Named models of the reference (SURVEY.md 2.1). The reference's
+DeepImageFeaturizer exposes the fc2 activations (4096-d) as the
+transfer-learning features for VGG; we do the same.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from sparkdl_tpu.models.common import Namer, ZooModule
+
+
+class _VGG(ZooModule):
+    blocks: tuple[tuple[int, int], ...] = ()
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        nm = Namer()
+        for n_convs, filters in self.blocks:
+            for _ in range(n_convs):
+                x = nn.relu(self._conv(nm, x, filters, 3))
+            x = nn.max_pool(x, (2, 2), (2, 2), "VALID")
+        # flatten (row-major HWC, matching Keras Flatten)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(self._dense(nm, x, 4096))  # fc1
+        features = nn.relu(self._dense(nm, x, 4096))  # fc2 -> featurization layer
+        if not self.include_top:
+            return features, None
+        logits = self._dense(nm, features, self.num_classes)
+        return features, nn.softmax(logits)
+
+
+class VGG16(_VGG):
+    blocks: tuple[tuple[int, int], ...] = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+
+
+class VGG19(_VGG):
+    blocks: tuple[tuple[int, int], ...] = ((2, 64), (2, 128), (4, 256), (4, 512), (4, 512))

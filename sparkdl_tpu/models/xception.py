@@ -1,0 +1,74 @@
+"""Xception in Flax (keras.applications.xception-equivalent).
+
+Named model of the reference (SURVEY.md 2.1). Separable convs are a
+depthwise + pointwise conv pair (models/common._sepconv); all convs
+bias-free, BN default epsilon. Residual 1x1 convs are constructed before
+the block body, matching Keras construction order for weight conversion.
+
+features = global-average-pooled block14 output (2048-d).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from sparkdl_tpu.models.common import (
+    Namer,
+    ZooModule,
+    global_avg_pool,
+    max_pool,
+)
+
+
+class Xception(ZooModule):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        nm = Namer()
+
+        def bn(x):
+            return self._bn(nm, x, train)
+
+        def sep(x, filters):
+            return bn(self._sepconv(nm, x, filters, 3))
+
+        # -- entry flow ----------------------------------------------------
+        x = self._conv(nm, x, 32, 3, strides=2, padding="VALID", use_bias=False)
+        x = nn.relu(bn(x))
+        x = self._conv(nm, x, 64, 3, padding="VALID", use_bias=False)
+        x = nn.relu(bn(x))
+
+        # Residual conv/BN are created AFTER the block body (Keras
+        # topological order, which the weight converter replays).
+        # block2: no leading relu on the first sepconv
+        y = sep(x, 128)
+        y = sep(nn.relu(y), 128)
+        res = bn(self._conv(nm, x, 128, 1, strides=2, use_bias=False))
+        x = max_pool(y, 3, 2, "SAME") + res
+
+        for filters in (256, 728):  # blocks 3-4
+            y = sep(nn.relu(x), filters)
+            y = sep(nn.relu(y), filters)
+            res = bn(self._conv(nm, x, filters, 1, strides=2, use_bias=False))
+            x = max_pool(y, 3, 2, "SAME") + res
+
+        # -- middle flow: 8 identity blocks --------------------------------
+        for _ in range(8):
+            res = x
+            for _ in range(3):
+                x = sep(nn.relu(x), 728)
+            x = x + res
+
+        # -- exit flow -----------------------------------------------------
+        y = sep(nn.relu(x), 728)
+        y = sep(nn.relu(y), 1024)
+        res = bn(self._conv(nm, x, 1024, 1, strides=2, use_bias=False))
+        x = max_pool(y, 3, 2, "SAME") + res
+
+        x = nn.relu(sep(x, 1536))
+        x = nn.relu(sep(x, 2048))
+
+        features = global_avg_pool(x)
+        if not self.include_top:
+            return features, None
+        logits = self._dense(nm, features, self.num_classes)
+        return features, nn.softmax(logits)

@@ -1,0 +1,17 @@
+from sparkdl_tpu.ops.preprocess import (
+    PREPROCESSORS,
+    preprocess_caffe,
+    preprocess_identity,
+    preprocess_tf,
+    preprocess_torch,
+    resize_images,
+)
+
+__all__ = [
+    "PREPROCESSORS",
+    "preprocess_caffe",
+    "preprocess_identity",
+    "preprocess_tf",
+    "preprocess_torch",
+    "resize_images",
+]

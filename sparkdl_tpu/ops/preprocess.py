@@ -1,0 +1,61 @@
+"""Model input preprocessing, in JAX, fused into the jitted forward.
+
+Parity with keras.applications preprocess_input modes used by the
+reference's named-model registry (SURVEY.md 2.1): 'tf' (inception/xception),
+'caffe' (resnet/vgg), 'torch'. Inputs are RGB float arrays in [0, 255] with
+shape (..., H, W, 3); outputs are what each model family expects. Running
+inside jit means preprocessing rides the same fusion as the model itself —
+the reference spliced decode/resize *TF graph nodes* for the same reason
+(SURVEY.md 2.10).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_CAFFE_MEAN_BGR = (103.939, 116.779, 123.68)
+_TORCH_MEAN = (0.485, 0.456, 0.406)
+_TORCH_STD = (0.229, 0.224, 0.225)
+
+
+def preprocess_tf(x: jnp.ndarray) -> jnp.ndarray:
+    """Scale [0,255] -> [-1, 1]."""
+    return x / 127.5 - 1.0
+
+
+def preprocess_caffe(x: jnp.ndarray) -> jnp.ndarray:
+    """RGB -> BGR, subtract ImageNet channel means (no scaling)."""
+    x = x[..., ::-1]
+    mean = jnp.asarray(_CAFFE_MEAN_BGR, dtype=x.dtype)
+    return x - mean
+
+
+def preprocess_torch(x: jnp.ndarray) -> jnp.ndarray:
+    x = x / 255.0
+    mean = jnp.asarray(_TORCH_MEAN, dtype=x.dtype)
+    std = jnp.asarray(_TORCH_STD, dtype=x.dtype)
+    return (x - mean) / std
+
+
+def preprocess_identity(x: jnp.ndarray) -> jnp.ndarray:
+    return x
+
+
+PREPROCESSORS = {
+    "tf": preprocess_tf,
+    "caffe": preprocess_caffe,
+    "torch": preprocess_torch,
+    "identity": preprocess_identity,
+}
+
+
+def resize_images(x: jnp.ndarray, height: int, width: int,
+                  method: str = "bilinear") -> jnp.ndarray:
+    """Batched image resize on device (jax.image.resize, antialias off to
+    match TF1-style resize the reference graphs used)."""
+    import jax.image
+
+    batch = x.shape[:-3]
+    return jax.image.resize(
+        x, (*batch, height, width, x.shape[-1]), method=method, antialias=False
+    )

@@ -1,0 +1,11 @@
+from sparkdl_tpu.transformers.named_image import (
+    DeepImageFeaturizer,
+    DeepImagePredictor,
+)
+from sparkdl_tpu.transformers.keras_tensor import KerasTransformer
+
+__all__ = [
+    "DeepImageFeaturizer",
+    "DeepImagePredictor",
+    "KerasTransformer",
+]

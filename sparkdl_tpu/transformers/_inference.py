@@ -1,0 +1,95 @@
+"""Shared batched-inference engine for all transformers.
+
+The TPU-native replacement for the reference's per-partition
+``Session.run`` hot loop (SURVEY.md 3.1/3.2): a jitted apply function mapped
+over bucketed, padded batches with double-buffered host→device prefetch.
+jit's shape-keyed cache means each bucket size compiles exactly once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator, Sequence
+
+import jax
+import numpy as np
+
+from sparkdl_tpu.runtime.batching import default_buckets, rebatch
+from sparkdl_tpu.runtime.prefetch import prefetch_to_device
+
+
+@dataclasses.dataclass
+class BatchedRunner:
+    """Maps ``apply_fn(batch_dict) -> output array(s)`` over row streams.
+
+    apply_fn must be shape-polymorphic only across the bucket set (it is
+    jitted; one compile per bucket). Outputs follow the batch leading dim.
+    """
+
+    apply_fn: Callable[[dict[str, Any]], Any]
+    batch_size: int = 64
+    prefetch: int = 2
+
+    def __post_init__(self):
+        self._jitted = jax.jit(self.apply_fn)
+        self._buckets = default_buckets(self.batch_size)
+
+    def run(self, rows: Iterator[dict[str, np.ndarray]]) -> Iterator[np.ndarray]:
+        """Yield one output array per input row, in order."""
+        batches = rebatch(rows, self.batch_size, self._buckets)
+        # keep (n_valid) alongside the device computation
+        metas: list[int] = []
+
+        def device_batches():
+            for b in batches:
+                metas.append(b.n_valid)
+                yield b.arrays
+
+        results = prefetch_to_device(
+            device_batches(), size=self.prefetch, transfer=self._transfer
+        )
+        for i, out in enumerate(map(self._jitted, results)):
+            out = np.asarray(out)
+            yield from out[: metas[i]]
+
+    def _transfer(self, arrays: dict[str, np.ndarray]):
+        return jax.device_put(arrays)
+
+
+def run_partition_with_passthrough(
+    rows: "list[dict]",
+    extract: Callable[[dict], dict[str, np.ndarray]],
+    runner: BatchedRunner,
+    output_col: str,
+    postprocess: Callable[[np.ndarray], Any] | None = None,
+) -> Iterator[dict]:
+    """Run inference for a partition, appending ``output_col`` to each row.
+
+    ``extract`` turns a row into the numeric feature dict the model eats;
+    rows it raises on are yielded unchanged with output None (mirrors the
+    reference's tolerance of undecodable rows).
+    """
+    feeds: list[dict[str, np.ndarray] | None] = []
+    for r in rows:
+        try:
+            feeds.append(extract(r))
+        except Exception:
+            feeds.append(None)
+    valid = [f for f in feeds if f is not None]
+    outputs = runner.run(iter(valid)) if valid else iter(())
+    for r, f in zip(rows, feeds):
+        out_row = dict(r)
+        if f is None:
+            out_row[output_col] = None
+        else:
+            o = next(outputs)
+            out_row[output_col] = postprocess(o) if postprocess else o
+        yield out_row
+
+
+def uniform_shape(arrays: Sequence[np.ndarray]) -> "tuple | None":
+    """The common shape of a list of arrays, or None if ragged."""
+    if not arrays:
+        return None
+    s = arrays[0].shape
+    return s if all(a.shape == s for a in arrays[1:]) else None

@@ -1,0 +1,129 @@
+"""KerasTransformer — batch inference with a user Keras model over a
+column of 1-D numeric arrays.
+
+Reference parity (SURVEY.md 2.3, [U: python/sparkdl/transformers/
+keras_tensor.py]): the reference loads the HDF5 model, freezes it to a TF
+GraphDef and runs it via TFTransformer. Here the model executes natively on
+JAX (Keras 3 jax backend): ``stateless_call`` is a pure function of the
+weights and inputs, so it jits and shards like any other JAX code — no
+freezing step exists or is needed.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+from sparkdl_tpu.dataframe import transform_partitions
+from sparkdl_tpu.param import (
+    HasBatchSize,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    SparkDLTypeConverters,
+    Transformer,
+)
+from sparkdl_tpu.transformers._inference import (
+    BatchedRunner,
+    run_partition_with_passthrough,
+)
+
+
+@functools.lru_cache(maxsize=16)
+def _load_keras_predictor(model_file: str, mtime: float):
+    """Per-process cache: load the model once per (file, mtime).
+
+    Returns ``predict(batch_dict) -> np.ndarray`` built on stateless_call
+    when Keras runs on the jax backend, else a plain __call__ fallback.
+    """
+    import keras
+
+    model = keras.models.load_model(model_file, compile=False)
+    if keras.backend.backend() == "jax":
+        trainable = [v.value for v in model.trainable_variables]
+        non_trainable = [v.value for v in model.non_trainable_variables]
+
+        def apply_fn(batch):
+            y, _ = model.stateless_call(
+                trainable, non_trainable, batch["x"], training=False
+            )
+            return y
+
+        return apply_fn, True
+    # Non-jax Keras backend (user overrode KERAS_BACKEND): still correct,
+    # not jit-compiled.
+    def apply_np(batch):
+        return np.asarray(model(batch["x"], training=False))
+
+    return apply_np, False
+
+
+class KerasTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
+    modelFile = Param(
+        None, "modelFile", "path to the Keras model (.h5 or .keras)",
+        SparkDLTypeConverters.toExistingFilePath,
+    )
+
+    def __init__(self, inputCol=None, outputCol=None, modelFile=None,
+                 batchSize=None):
+        super().__init__()
+        self._setDefault(batchSize=256)
+        self._set(inputCol=inputCol, outputCol=outputCol, modelFile=modelFile,
+                  batchSize=batchSize)
+
+    def setModelFile(self, value: str):
+        return self._set(modelFile=value)
+
+    def getModelFile(self) -> str:
+        return self.getOrDefault("modelFile")
+
+    def _transform(self, dataset):
+        model_file = self.getModelFile()
+        mtime = os.path.getmtime(model_file)
+        batch_size = self.getBatchSize()
+        input_col = self.getInputCol()
+        output_col = self.getOutputCol()
+
+        def partition_fn(rows):
+            rows = list(rows)
+            if not rows:
+                return iter(())
+            apply_fn, jittable = _load_keras_predictor(model_file, mtime)
+            if jittable:
+                runner = BatchedRunner(apply_fn, batch_size=batch_size)
+            else:
+                runner = _EagerRunner(apply_fn, batch_size)
+
+            def extract(row):
+                arr = np.asarray(row[input_col], dtype=np.float32)
+                if arr.ndim != 1:
+                    raise ValueError(
+                        f"KerasTransformer input must be 1-D, got {arr.shape}"
+                    )
+                return {"x": arr}
+
+            return run_partition_with_passthrough(
+                rows, extract, runner, output_col,
+                lambda o: np.asarray(o, dtype=np.float32),
+            )
+
+        return transform_partitions(
+            dataset, partition_fn, [(output_col, "array<float>")]
+        )
+
+
+class _EagerRunner:
+    """BatchedRunner-shaped wrapper for non-jittable backends."""
+
+    def __init__(self, apply_fn, batch_size: int):
+        self.apply_fn = apply_fn
+        self.batch_size = batch_size
+
+    def run(self, rows):
+        from sparkdl_tpu.runtime.batching import rebatch
+
+        for b in rebatch(rows, self.batch_size, (self.batch_size,)):
+            out = np.asarray(self.apply_fn(b.arrays))
+            yield from out[: b.n_valid]

@@ -1,0 +1,229 @@
+"""DeepImagePredictor / DeepImageFeaturizer — named pretrained-model
+transformers.
+
+Reference parity (SURVEY.md 2.1, [U: python/sparkdl/transformers/
+named_image.py]): apply a named ImageNet model to an image column;
+the Predictor emits class probabilities (optionally top-K decoded), the
+Featurizer emits penultimate-layer features for transfer learning. The
+reference routes through a frozen TF graph in the executor JVM (2.2); here
+the model is a Flax module jitted on the TPU host, fed by the shared
+bucketed/prefetched runner.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import numpy as np
+
+from sparkdl_tpu.dataframe import transform_partitions
+from sparkdl_tpu.image.imageIO import imageStructToArray
+from sparkdl_tpu.image.schema import UNDEFINED_MODE, is_image_struct
+from sparkdl_tpu.models.registry import SUPPORTED_MODELS, get_entry
+from sparkdl_tpu.ops.preprocess import PREPROCESSORS
+from sparkdl_tpu.param import (
+    HasBatchSize,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    SparkDLTypeConverters,
+    Transformer,
+)
+from sparkdl_tpu.transformers._inference import (
+    BatchedRunner,
+    run_partition_with_passthrough,
+)
+
+
+@functools.lru_cache(maxsize=8)
+def _load_named_model(model_name: str, weights: "str | None", include_top: bool):
+    """Per-process cache so Spark executors build each model once."""
+    from sparkdl_tpu.models.registry import build_flax_model
+
+    return build_flax_model(model_name, weights=weights, include_top=include_top)
+
+
+def _resize_host(arr: np.ndarray, size: tuple[int, int]) -> np.ndarray:
+    """Per-row host resize (PIL bilinear) for ragged image sizes — the
+    uniform-size fast path skips this entirely."""
+    from PIL import Image
+
+    h, w = size
+    if arr.shape[:2] == (h, w):
+        return arr.astype(np.float32)
+    if arr.dtype != np.uint8:
+        arr = np.clip(arr, 0, 255).astype(np.uint8)
+    if arr.shape[-1] == 1:
+        arr = np.repeat(arr, 3, axis=-1)
+    img = Image.fromarray(arr).resize((w, h), Image.BILINEAR)
+    return np.asarray(img, dtype=np.float32)
+
+
+def _image_to_rgb_array(value: Any) -> np.ndarray:
+    """Accept an image struct (BGR, Spark convention) or ndarray (RGB)."""
+    if is_image_struct(value):
+        if value["mode"] == UNDEFINED_MODE:
+            raise ValueError("undefined image")
+        arr = imageStructToArray(value)
+        if arr.shape[-1] >= 3:  # stored BGR -> RGB
+            arr = arr[..., 2::-1] if arr.shape[-1] == 3 else np.concatenate(
+                [arr[..., 2::-1], arr[..., 3:]], axis=-1
+            )
+        return np.asarray(arr[..., :3])
+    arr = np.asarray(value)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr[..., :3]
+
+
+class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
+    """Shared engine for the named-model transformers."""
+
+    modelName = Param(
+        None, "modelName", "name of the pretrained model",
+        SparkDLTypeConverters.supportedNameConverter(list(SUPPORTED_MODELS)),
+    )
+    weights = Param(
+        None, "weights",
+        "'imagenet', a local Keras .h5/.keras file, or None for random init",
+    )
+
+    _include_top: bool = True
+
+    def __init__(self, inputCol=None, outputCol=None, modelName=None,
+                 batchSize=None, weights=None):
+        super().__init__()
+        self._setDefault(batchSize=64, weights="imagenet")
+        self._set(inputCol=inputCol, outputCol=outputCol, modelName=modelName,
+                  batchSize=batchSize, weights=weights)
+
+    def setModelName(self, value: str):
+        return self._set(modelName=value)
+
+    def getModelName(self) -> str:
+        return self.getOrDefault("modelName")
+
+    # subclasses pick which head of (features, probs) to emit
+    def _select_output(self, features, probs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _postprocess(self, out: np.ndarray):
+        return out
+
+    def _output_schema(self) -> list[tuple[str, str]]:
+        return [(self.getOutputCol(), "array<float>")]
+
+    def _transform(self, dataset):
+        model_name = self.getModelName()
+        weights = self.getOrDefault("weights")
+        batch_size = self.getBatchSize()
+        input_col = self.getInputCol()
+        output_col = self.getOutputCol()
+        include_top = self._include_top
+        select_output = self._select_output
+        postprocess = self._postprocess
+
+        entry = get_entry(model_name)
+        size = entry.input_size
+        preprocess = PREPROCESSORS[entry.preprocess]
+
+        def partition_fn(rows):
+            rows = list(rows)
+            if not rows:
+                return iter(())
+            module, variables = _load_named_model(model_name, weights, include_top)
+
+            def apply_fn(batch):
+                x = preprocess(batch["img"])
+                features, probs = module.apply(variables, x, train=False)
+                return select_output(features, probs)
+
+            runner = BatchedRunner(apply_fn, batch_size=batch_size)
+
+            def extract(row):
+                arr = _image_to_rgb_array(row[input_col])
+                return {"img": _resize_host(arr, size)}
+
+            return run_partition_with_passthrough(
+                rows, extract, runner, output_col, postprocess
+            )
+
+        return transform_partitions(dataset, partition_fn, self._output_schema())
+
+
+class DeepImageFeaturizer(_NamedImageTransformer):
+    """Transfer-learning featurizer: penultimate-layer activations.
+
+    Reference: [U: python/sparkdl/transformers/named_image.py]
+    DeepImageFeaturizer (py wrapper of the Scala core, SURVEY.md 2.1/2.2).
+    """
+
+    _include_top = False
+
+    def _select_output(self, features, probs):
+        return features
+
+    def _postprocess(self, out):
+        return np.asarray(out, dtype=np.float32)
+
+
+class DeepImagePredictor(_NamedImageTransformer):
+    """Class-probability predictor with optional top-K decoding."""
+
+    decodePredictions = Param(
+        None, "decodePredictions",
+        "emit top-K (class, description, probability) instead of raw probabilities",
+        SparkDLTypeConverters.toBoolean,
+    )
+    topK = Param(None, "topK", "K for decodePredictions",
+                 SparkDLTypeConverters.toInt)
+
+    _include_top = True
+
+    def __init__(self, inputCol=None, outputCol=None, modelName=None,
+                 batchSize=None, weights=None, decodePredictions=None,
+                 topK=None):
+        super().__init__(inputCol, outputCol, modelName, batchSize, weights)
+        self._setDefault(decodePredictions=False, topK=5)
+        self._set(decodePredictions=decodePredictions, topK=topK)
+
+    def _select_output(self, features, probs):
+        return probs
+
+    def _postprocess(self, out):
+        probs = np.asarray(out, dtype=np.float32)
+        if not self.getOrDefault("decodePredictions"):
+            return probs
+        k = self.getOrDefault("topK")
+        top = np.argsort(probs)[::-1][:k]
+        return [(int(i), _class_description(int(i)), float(probs[i])) for i in top]
+
+    def _output_schema(self):
+        if self.getOrDefault("decodePredictions"):
+            return [(self.getOutputCol(),
+                     "array<struct<class:int,description:string,probability:float>>")]
+        return [(self.getOutputCol(), "array<float>")]
+
+
+@functools.lru_cache(maxsize=1)
+def _imagenet_class_index() -> "dict[int, tuple[str, str]] | None":
+    """ImageNet class index if cached locally (zero-egress: no download)."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.expanduser("~"), ".keras", "models", "imagenet_class_index.json"
+    )
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        raw = json.load(f)
+    return {int(k): (v[0], v[1]) for k, v in raw.items()}
+
+
+def _class_description(idx: int) -> str:
+    index = _imagenet_class_index()
+    if index and idx in index:
+        return index[idx][1]
+    return f"class_{idx}"

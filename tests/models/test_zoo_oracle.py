@@ -1,0 +1,129 @@
+"""Oracle tests: Flax zoo forward == Keras original forward, same weights.
+
+This is the reference's load-bearing test pattern (SURVEY.md §4): the
+pipeline's model must match the plain framework model numerically. We build
+each keras.applications architecture with random init (no downloads in the
+sandbox), convert weights order-based, and compare outputs.
+
+Small spatial inputs keep CPU time down: the conv stacks are size-agnostic
+above each architecture's minimum; VGG's classifier fixes its input at 224.
+"""
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+
+def _keras_forward(kmodel, x):
+    return np.asarray(kmodel(x, training=False))
+
+
+def _flax_forward(module, variables, x):
+    feats, probs = module.apply(variables, x, train=False)
+    return (np.asarray(feats), None if probs is None else np.asarray(probs))
+
+
+def _convert(kmodel, layer_order="topo"):
+    from sparkdl_tpu.models.keras_loader import keras_to_flax_variables
+
+    return keras_to_flax_variables(kmodel, layer_order=layer_order)
+
+
+def _check(kfeat, feat, tol=2e-4):
+    np.testing.assert_allclose(kfeat, feat, rtol=tol, atol=tol)
+
+
+@pytest.fixture(scope="module")
+def rng_img():
+    r = np.random.default_rng(7)
+
+    def make(h, w, n=2):
+        return (r.random((n, h, w, 3)) * 255).astype(np.float32)
+
+    return make
+
+
+class TestOracleFeatures:
+    """include_top=False + pooling='avg' against our features output."""
+
+    @pytest.mark.parametrize(
+        "name,size",
+        [("ResNet50", 96), ("InceptionV3", 128), ("Xception", 128)],
+    )
+    def test_features_match(self, name, size, rng_img):
+        from sparkdl_tpu.models.registry import get_entry
+
+        entry = get_entry(name)
+        import importlib
+
+        mod_name, attr = entry.keras_builder_path.split(":")
+        builder = getattr(
+            importlib.import_module(f"keras.applications.{mod_name}"), attr
+        )
+        kmodel = builder(
+            weights=None, include_top=False, pooling="avg",
+            input_shape=(size, size, 3),
+        )
+        x = rng_img(size, size)
+        # normalize to roughly centered inputs so activations are tame
+        x = x / 127.5 - 1.0
+        kfeat = _keras_forward(kmodel, x)
+
+        module = entry.flax_builder(include_top=False)
+        variables = _convert(kmodel, entry.layer_order)
+        feat, _ = _flax_forward(module, variables, x)
+        assert feat.shape == (2, entry.feature_dim)
+        _check(kfeat, feat)
+
+
+class TestOracleTop:
+    def test_resnet50_classifier_matches(self, rng_img):
+        from sparkdl_tpu.models.registry import build_keras_model, get_entry
+
+        entry = get_entry("ResNet50")
+        kmodel = build_keras_model(entry, weights=None, include_top=True)
+        x = rng_img(224, 224, n=1) / 255.0
+        kprob = _keras_forward(kmodel, x)
+
+        module = entry.flax_builder(include_top=True)
+        variables = _convert(kmodel)
+        _, prob = _flax_forward(module, variables, x)
+        assert prob.shape == (1, 1000)
+        np.testing.assert_allclose(kprob, prob, rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(prob.sum(axis=-1), 1.0, rtol=1e-5)
+
+    @pytest.mark.slow
+    def test_vgg16_fc2_features_match(self, rng_img):
+        from sparkdl_tpu.models.registry import build_keras_model, get_entry
+
+        entry = get_entry("VGG16")
+        kmodel = build_keras_model(entry, weights=None, include_top=True)
+        x = rng_img(224, 224, n=1) / 255.0
+        # keras fc2 activations
+        import keras as K
+
+        fc2 = K.Model(kmodel.inputs, kmodel.get_layer("fc2").output)
+        kfeat = np.asarray(fc2(x, training=False))
+
+        module = entry.flax_builder(include_top=True)
+        variables = _convert(kmodel)
+        feat, _ = _flax_forward(module, variables, x)
+        _check(kfeat, feat, tol=5e-4)
+
+
+class TestConversionSafety:
+    def test_shape_mismatch_is_loud(self):
+        from sparkdl_tpu.models.keras_loader import check_variables_match
+
+        with pytest.raises(ValueError, match="conversion mismatch"):
+            check_variables_match(
+                {"params": {"conv000": {"kernel": np.zeros((3, 3, 3, 8))}}},
+                {"params": {"conv000": {"kernel": np.zeros((3, 3, 3, 16))}}},
+            )
+
+    def test_unknown_model_rejected(self):
+        from sparkdl_tpu.models.registry import get_entry
+
+        with pytest.raises(ValueError, match="unknown model"):
+            get_entry("NASNetMega")

@@ -1,0 +1,76 @@
+"""KerasTransformer tests: oracle vs model.predict (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+from sparkdl_tpu.dataframe import LocalDataFrame
+from sparkdl_tpu.transformers import KerasTransformer
+
+
+@pytest.fixture(scope="module")
+def mlp_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("models") / "mlp.h5"
+    model = keras.Sequential(
+        [
+            keras.layers.Input((10,)),
+            keras.layers.Dense(32, activation="relu"),
+            keras.layers.Dense(8, activation="relu"),
+            keras.layers.Dense(3, activation="softmax"),
+        ]
+    )
+    model.save(path)
+    return str(path), model
+
+
+class TestKerasTransformer:
+    def test_oracle_vs_predict(self, mlp_file):
+        path, model = mlp_file
+        r = np.random.default_rng(0)
+        X = r.standard_normal((23, 10)).astype(np.float32)
+        df = LocalDataFrame.from_rows(
+            [{"feat": x} for x in X], num_partitions=3
+        )
+        out = KerasTransformer(
+            inputCol="feat", outputCol="pred", modelFile=path, batchSize=8
+        ).transform(df).collect()
+        got = np.stack([row["pred"] for row in out])
+        want = np.asarray(model(X, training=False))
+        np.testing.assert_allclose(want, got, rtol=1e-5, atol=1e-6)
+
+    def test_list_inputs_accepted(self, mlp_file):
+        path, model = mlp_file
+        df = LocalDataFrame.from_rows([{"feat": [0.0] * 10}])
+        out = KerasTransformer(
+            inputCol="feat", outputCol="pred", modelFile=path
+        ).transform(df).collect()
+        assert len(out[0]["pred"]) == 3
+
+    def test_bad_input_rank_yields_none(self, mlp_file):
+        path, _ = mlp_file
+        df = LocalDataFrame.from_rows(
+            [{"feat": np.zeros((2, 5), np.float32)}]
+        )
+        out = KerasTransformer(
+            inputCol="feat", outputCol="pred", modelFile=path
+        ).transform(df).collect()
+        assert out[0]["pred"] is None
+
+    def test_missing_model_file_rejected_at_set(self):
+        with pytest.raises(ValueError, match="does not exist"):
+            KerasTransformer(inputCol="x", outputCol="y",
+                             modelFile="/nope/missing.h5")
+
+    def test_pandas_backend(self, mlp_file):
+        import pandas as pd
+
+        path, model = mlp_file
+        pdf = pd.DataFrame({"feat": [np.ones(10, np.float32)] * 4})
+        out = KerasTransformer(
+            inputCol="feat", outputCol="pred", modelFile=path
+        ).transform(pdf)
+        assert isinstance(out, pd.DataFrame)
+        got = np.stack(list(out["pred"]))
+        want = np.asarray(model(np.ones((4, 10), np.float32), training=False))
+        np.testing.assert_allclose(want, got, rtol=1e-5, atol=1e-6)

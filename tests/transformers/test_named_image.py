@@ -1,0 +1,121 @@
+"""DeepImageFeaturizer / DeepImagePredictor tests.
+
+Oracle pattern (SURVEY.md §4): pipeline output must match the plain Keras
+model applied to the same preprocessed batch. Weights come from a saved
+Keras file so both sides share them exactly.
+"""
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+from sparkdl_tpu.dataframe import LocalDataFrame
+from sparkdl_tpu.image.imageIO import imageArrayToStructBGR
+from sparkdl_tpu.transformers import DeepImageFeaturizer, DeepImagePredictor
+
+
+@pytest.fixture(scope="module")
+def resnet_file(tmp_path_factory):
+    """Small random-weight ResNet50 saved to disk (shared across tests)."""
+    path = tmp_path_factory.mktemp("models") / "resnet50.keras"
+    kmodel = keras.applications.resnet.ResNet50(weights=None)
+    kmodel.save(path)
+    return str(path), kmodel
+
+
+@pytest.fixture(scope="module")
+def image_df():
+    r = np.random.default_rng(3)
+    rows = []
+    for i in range(5):
+        # ragged sizes force the host-resize path
+        h, w = 200 + 10 * i, 180 + 5 * i
+        arr = r.integers(0, 256, (h, w, 3), dtype=np.uint8)
+        rows.append({"image": imageArrayToStructBGR(arr, origin=f"img{i}")})
+    return LocalDataFrame.from_rows(rows, num_partitions=2), rows
+
+
+def _keras_reference_batch(rows, size=224):
+    from PIL import Image
+
+    from sparkdl_tpu.image.imageIO import imageStructToArray
+
+    batch = []
+    for r in rows:
+        arr = imageStructToArray(r["image"])[..., ::-1]  # BGR -> RGB
+        img = Image.fromarray(arr).resize((size, size), Image.BILINEAR)
+        batch.append(np.asarray(img, dtype=np.float32))
+    x = np.stack(batch)
+    return keras.applications.resnet.preprocess_input(x)
+
+
+class TestDeepImageFeaturizer:
+    def test_oracle_vs_keras(self, resnet_file, image_df):
+        path, kmodel = resnet_file
+        df, rows = image_df
+        feat = DeepImageFeaturizer(
+            inputCol="image", outputCol="features", modelName="ResNet50",
+            weights=path, batchSize=4,
+        )
+        out = feat.transform(df).collect()
+        got = np.stack([r["features"] for r in out])
+        assert got.shape == (5, 2048)
+
+        x = _keras_reference_batch(rows)
+        pool = keras.Model(
+            kmodel.inputs, kmodel.get_layer("avg_pool").output
+        )
+        want = np.asarray(pool(x, training=False))
+        np.testing.assert_allclose(want, got, rtol=2e-4, atol=2e-4)
+
+    def test_undecodable_row_yields_none(self, resnet_file):
+        path, _ = resnet_file
+        from sparkdl_tpu.image.imageIO import undefined_image
+
+        df = LocalDataFrame.from_rows(
+            [{"image": undefined_image("bad")},
+             {"image": imageArrayToStructBGR(
+                 np.zeros((64, 64, 3), np.uint8), "ok")}]
+        )
+        out = DeepImageFeaturizer(
+            inputCol="image", outputCol="features", modelName="ResNet50",
+            weights=path,
+        ).transform(df).collect()
+        assert out[0]["features"] is None
+        assert out[1]["features"] is not None
+
+
+class TestDeepImagePredictor:
+    def test_probabilities_and_topk(self, resnet_file, image_df):
+        path, kmodel = resnet_file
+        df, rows = image_df
+        pred = DeepImagePredictor(
+            inputCol="image", outputCol="probs", modelName="ResNet50",
+            weights=path, batchSize=4,
+        )
+        out = pred.transform(df).collect()
+        probs = np.stack([r["probs"] for r in out])
+        assert probs.shape == (5, 1000)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+
+        x = _keras_reference_batch(rows)
+        want = np.asarray(kmodel(x, training=False))
+        np.testing.assert_allclose(want, probs, rtol=1e-3, atol=1e-5)
+
+        top = DeepImagePredictor(
+            inputCol="image", outputCol="preds", modelName="ResNet50",
+            weights=path, decodePredictions=True, topK=3,
+        ).transform(df).collect()
+        preds = top[0]["preds"]
+        assert len(preds) == 3
+        cls, desc, p = preds[0]
+        assert isinstance(cls, int) and isinstance(desc, str)
+        # sorted descending
+        assert preds[0][2] >= preds[1][2] >= preds[2][2]
+
+    def test_bad_model_name(self):
+        with pytest.raises(ValueError, match="not in supported set"):
+            DeepImagePredictor(
+                inputCol="image", outputCol="p", modelName="AlexNet"
+            )
