@@ -1,0 +1,15 @@
+"""Graph ingestion & surgery — TF-artifact → JAX-callable lowering.
+
+Parity with the reference's graph layer (SURVEY.md 2.7/2.9/2.10, [U:
+python/sparkdl/graph/]): ``TFInputGraph`` (six ingestion constructors),
+``GraphFunction`` + ``IsolatedSession`` (graph surgery), and the image
+converter piece. The reference hands frozen GraphDefs to a TF session in the
+executor JVM; here ingestion ends in a **jittable JAX function** (XLA-lowered
+via ``jax2tf.call_tf``) so ingested graphs fuse, shard and run on TPU like
+native JAX code.
+"""
+
+from sparkdl_tpu.graph.builder import GraphFunction, IsolatedSession
+from sparkdl_tpu.graph.input import TFInputGraph
+
+__all__ = ["GraphFunction", "IsolatedSession", "TFInputGraph"]

@@ -1,0 +1,187 @@
+"""Graph surgery: IsolatedSession, GraphFunction, freeze/strip, JAX lowering.
+
+Parity with the reference's builder (SURVEY.md 2.9, [U:
+python/sparkdl/graph/builder.py]). ``IsolatedSession`` keeps every ingestion
+in a fresh ``tf.Graph`` so no state leaks across models (the reference's
+race-isolation discipline); ``GraphFunction`` is the serializable
+(graph_def, inputs, outputs) unit. The TPU-native addition is
+:meth:`GraphFunction.to_jax`: lower the frozen graph through TF's XLA bridge
+(``jax2tf.call_tf``) into a function that jits, fuses and shards like any
+other JAX code — the reference instead ships the GraphDef to a JVM-side TF
+session ([U: tensorframes], SURVEY.md 2.15).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+from sparkdl_tpu.graph import utils as tfx
+from sparkdl_tpu.graph._tf import require_tf
+
+
+@dataclasses.dataclass
+class GraphFunction:
+    """A frozen TF computation: GraphDef + ordered input/output tensor names."""
+
+    graph_def: Any  # tf.compat.v1.GraphDef
+    input_names: list[str]
+    output_names: list[str]
+
+    def dump(self, path: str) -> None:
+        """Serialize to a file (proto bytes + name lists, self-contained)."""
+        import json
+
+        payload = {
+            "input_names": self.input_names,
+            "output_names": self.output_names,
+        }
+        with open(path, "wb") as f:
+            header = json.dumps(payload).encode("utf-8")
+            f.write(len(header).to_bytes(8, "little"))
+            f.write(header)
+            f.write(self.graph_def.SerializeToString())
+
+    @staticmethod
+    def load(path: str) -> "GraphFunction":
+        import json
+
+        tf = require_tf()
+        with open(path, "rb") as f:
+            n = int.from_bytes(f.read(8), "little")
+            payload = json.loads(f.read(n).decode("utf-8"))
+            gdef = tf.compat.v1.GraphDef()
+            gdef.ParseFromString(f.read())
+        return GraphFunction(gdef, payload["input_names"], payload["output_names"])
+
+    # -- TPU-native lowering ----------------------------------------------
+    def to_jax(self) -> Callable[..., tuple]:
+        """Lower to a jittable JAX function ``f(*arrays) -> tuple(arrays)``.
+
+        Inputs follow ``input_names`` order. Under ``jax.jit`` the TF graph
+        is compiled by TF's XLA bridge and inlined into the surrounding XLA
+        program (so it runs on TPU, not as a host callback). Graphs with ops
+        XLA cannot compile fail at first trace with the XLA error.
+        """
+        tf = require_tf()
+        from jax.experimental import jax2tf
+
+        gdef = self.graph_def
+        in_names = list(self.input_names)
+        out_names = list(self.output_names)
+        specs = placeholder_specs(gdef, in_names)
+
+        def tf_fn(*tensors):
+            mapping = dict(zip(in_names, tensors))
+            outs = tf.graph_util.import_graph_def(
+                gdef, input_map=mapping, return_elements=out_names, name=""
+            )
+            return tuple(outs)
+
+        wrapped = tf.compat.v1.wrap_function(tf_fn, signature=specs)
+        lowered = jax2tf.call_tf(wrapped, has_side_effects=False)
+
+        def fn(*arrays):
+            out = lowered(*arrays)
+            return out if isinstance(out, (tuple, list)) else (out,)
+
+        return fn
+
+
+def placeholder_specs(graph_def, tensor_names: Sequence[str]):
+    """TensorSpecs (dtype + shape, unknown dims as None) for graph inputs."""
+    tf = require_tf()
+    by_op = {n.name: n for n in graph_def.node}
+    specs = []
+    for tname in tensor_names:
+        node = by_op.get(tfx.op_name(tname))
+        if node is None:
+            raise KeyError(f"input op {tname!r} not found in graph_def")
+        dtype = tf.dtypes.as_dtype(node.attr["dtype"].type)
+        shape = None
+        if "shape" in node.attr and not node.attr["shape"].shape.unknown_rank:
+            shape = [
+                (d.size if d.size >= 0 else None)
+                for d in node.attr["shape"].shape.dim
+            ]
+        specs.append(tf.TensorSpec(shape, dtype, name=tfx.op_name(tname)))
+    return specs
+
+
+class IsolatedSession:
+    """A fresh tf.Graph + Session for safe, leak-free graph surgery.
+
+    Reference parity ([U: python/sparkdl/graph/builder.py] IsolatedSession):
+    a context manager whose graph/session never alias another model's.
+    ``using_keras`` is accepted for API parity; Keras 3 is sessionless, so it
+    only affects nothing and is recorded for introspection.
+    """
+
+    def __init__(self, graph=None, using_keras: bool = False):
+        tf = require_tf()
+        self._tf = tf
+        self.graph = graph if graph is not None else tf.Graph()
+        self.using_keras = bool(using_keras)
+        self.sess = tf.compat.v1.Session(graph=self.graph)
+
+    def __enter__(self) -> "IsolatedSession":
+        self._graph_ctx = self.graph.as_default()
+        self._graph_ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._graph_ctx.__exit__(*exc)
+        self.sess.close()
+        return False
+
+    def run(self, fetches, feed_dict=None):
+        return self.sess.run(fetches, feed_dict=feed_dict)
+
+    def asGraphFunction(self, inputs, outputs, strip_and_freeze: bool = True) -> GraphFunction:
+        """Export (a subgraph of) this session as a GraphFunction."""
+        in_names = [tfx.tensor_name(i, self.graph) for i in inputs]
+        out_names = [tfx.tensor_name(o, self.graph) for o in outputs]
+        if strip_and_freeze:
+            gdef = strip_and_freeze_upto(self.sess, self.graph, outputs)
+        else:
+            gdef = self.graph.as_graph_def()
+        return GraphFunction(gdef, in_names, out_names)
+
+    def importGraphFunction(self, gfn: GraphFunction, prefix: str = ""):
+        """Splice a GraphFunction into this graph; returns (inputs, outputs)."""
+        tf = self._tf
+        with self.graph.as_default():
+            scope = prefix if prefix else ""
+            elems = tf.graph_util.import_graph_def(
+                gfn.graph_def,
+                return_elements=list(gfn.input_names) + list(gfn.output_names),
+                name=scope,
+            )
+        n_in = len(gfn.input_names)
+        return elems[:n_in], elems[n_in:]
+
+
+def strip_and_freeze_upto(sess, graph, outputs):
+    """Freeze variables to constants and prune nodes not feeding ``outputs``.
+
+    Reference parity: strip_and_freeze_upto ([U: python/sparkdl/graph/
+    builder.py]) — constant folding of variables plus dead-node removal, so
+    the exported GraphDef is self-contained and minimal.
+    """
+    tf = require_tf()
+    out_ops = [tfx.op_name(o) for o in outputs]
+    gdef = graph.as_graph_def(add_shapes=True)
+    has_variables = any(
+        n.op in ("VariableV2", "Variable", "VarHandleOp") for n in gdef.node
+    )
+    if has_variables:
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # tf1 freeze API is deprecated, not gone
+            gdef = tf.compat.v1.graph_util.convert_variables_to_constants(
+                sess, gdef, out_ops
+            )
+    else:
+        gdef = tf.compat.v1.graph_util.extract_sub_graph(gdef, out_ops)
+    return gdef

@@ -1,0 +1,203 @@
+"""TFInputGraph — unified ingestion of TF model artifacts.
+
+Parity with the reference (SURVEY.md 2.7, [U: python/sparkdl/graph/input.py]):
+six constructors normalize (live graph | GraphDef | checkpoint | SavedModel,
+each optionally signature-driven) into one frozen-graph value with optional
+signature→tensor-name maps, consumed by TFTransformer/TFImageTransformer.
+The TPU-native difference is the exit path: :meth:`to_jax` lowers the frozen
+graph into a jittable JAX function instead of shipping it to a JVM TF session.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+from sparkdl_tpu.graph import utils as tfx
+from sparkdl_tpu.graph._tf import require_tf
+from sparkdl_tpu.graph.builder import GraphFunction, IsolatedSession, strip_and_freeze_upto
+
+_SERVING = "serving_default"
+_SERVE_TAG = "serve"
+
+
+@dataclasses.dataclass(eq=False)  # identity semantics: used as a cache key
+class TFInputGraph:
+    """A frozen TF graph plus endpoint metadata.
+
+    ``input_tensor_name_from_signature`` / ``output_tensor_name_from_signature``
+    map signature keys (e.g. ``"images"``) to tensor names (``"x:0"``); they
+    are None when the artifact carried no signature.
+    """
+
+    graph_def: Any
+    input_tensor_name_from_signature: "dict[str, str] | None"
+    output_tensor_name_from_signature: "dict[str, str] | None"
+    input_names: list[str]
+    output_names: list[str]
+
+    # -- signature translation (reference API) ----------------------------
+    def translateInputMapping(self, input_mapping) -> dict[str, str]:
+        """column→signature-key mapping → column→tensor-name mapping."""
+        items = input_mapping.items() if isinstance(input_mapping, dict) else input_mapping
+        out = {}
+        for col, key in sorted(items):
+            out[col] = self._resolve(key, self.input_tensor_name_from_signature)
+        return out
+
+    def translateOutputMapping(self, output_mapping) -> dict[str, str]:
+        """signature-key→column mapping → tensor-name→column mapping."""
+        items = output_mapping.items() if isinstance(output_mapping, dict) else output_mapping
+        out = {}
+        for key, col in sorted(items):
+            out[self._resolve(key, self.output_tensor_name_from_signature)] = col
+        return out
+
+    def _resolve(self, key: str, table: "dict[str, str] | None") -> str:
+        if table is not None:
+            if key in table:
+                return table[key]
+            raise KeyError(
+                f"signature key {key!r} not found; available: {sorted(table)}"
+            )
+        return tfx.tensor_name(key)
+
+    # -- TPU-native exit --------------------------------------------------
+    def asGraphFunction(self) -> GraphFunction:
+        return GraphFunction(self.graph_def, list(self.input_names), list(self.output_names))
+
+    def to_jax(self) -> Callable[..., tuple]:
+        """Jittable JAX function over arrays in ``input_names`` order."""
+        return self.asGraphFunction().to_jax()
+
+    # -- constructors -----------------------------------------------------
+    @classmethod
+    def fromGraph(cls, graph, sess, feed_names: Sequence[str], fetch_names: Sequence[str]) -> "TFInputGraph":
+        """From a live tf.Graph + session (variables frozen through sess)."""
+        return _from_session(graph, sess, feed_names, fetch_names, None)
+
+    @classmethod
+    def fromGraphDef(cls, graph_def, feed_names: Sequence[str], fetch_names: Sequence[str]) -> "TFInputGraph":
+        """From a serialized (already frozen) GraphDef."""
+        tf = require_tf()
+        with IsolatedSession() as issn:
+            tf.graph_util.import_graph_def(graph_def, name="")
+            return _from_session(
+                issn.graph, issn.sess, feed_names, fetch_names, None
+            )
+
+    @classmethod
+    def fromCheckpoint(cls, checkpoint_dir: str, feed_names: Sequence[str], fetch_names: Sequence[str]) -> "TFInputGraph":
+        """From a TF-1-style checkpoint directory (MetaGraph + variables)."""
+        with _restored_checkpoint(checkpoint_dir) as (issn, _meta):
+            return _from_session(issn.graph, issn.sess, feed_names, fetch_names, None)
+
+    @classmethod
+    def fromCheckpointWithSignature(cls, checkpoint_dir: str, signature_def_key: str = _SERVING) -> "TFInputGraph":
+        """Checkpoint whose MetaGraph carries a signature_def."""
+        with _restored_checkpoint(checkpoint_dir) as (issn, meta):
+            sig = _signature(meta, signature_def_key)
+            return _from_session(issn.graph, issn.sess, None, None, sig)
+
+    @classmethod
+    def fromSavedModel(
+        cls, saved_model_dir: str, tag_set: str = _SERVE_TAG,
+        feed_names: Sequence[str] = (), fetch_names: Sequence[str] = (),
+    ) -> "TFInputGraph":
+        """From a SavedModel with explicit feed/fetch tensor names."""
+        with _loaded_saved_model(saved_model_dir, tag_set) as (issn, _meta):
+            return _from_session(issn.graph, issn.sess, feed_names, fetch_names, None)
+
+    @classmethod
+    def fromSavedModelWithSignature(
+        cls, saved_model_dir: str, tag_set: str = _SERVE_TAG,
+        signature_def_key: str = _SERVING,
+    ) -> "TFInputGraph":
+        """From a SavedModel, endpoints resolved through its signature_def."""
+        with _loaded_saved_model(saved_model_dir, tag_set) as (issn, meta):
+            sig = _signature(meta, signature_def_key)
+            return _from_session(issn.graph, issn.sess, None, None, sig)
+
+
+# -- internals -------------------------------------------------------------
+
+def _signature(meta_graph_def, key: str):
+    sigs = meta_graph_def.signature_def
+    if key not in sigs:
+        raise KeyError(
+            f"signature_def {key!r} not found; available: {sorted(sigs)}"
+        )
+    sig = sigs[key]
+    inputs = {k: v.name for k, v in sig.inputs.items()}
+    outputs = {k: v.name for k, v in sig.outputs.items()}
+    return inputs, outputs
+
+
+def _from_session(graph, sess, feed_names, fetch_names, sig) -> TFInputGraph:
+    if sig is not None:
+        in_map, out_map = sig
+        input_names = [tfx.validated_input(t, graph) for t in in_map.values()]
+        output_names = [tfx.validated_output(t, graph) for t in out_map.values()]
+        in_table = {k: tfx.tensor_name(v) for k, v in in_map.items()}
+        out_table = {k: tfx.tensor_name(v) for k, v in out_map.items()}
+    else:
+        input_names = [tfx.validated_input(t, graph) for t in feed_names]
+        output_names = [tfx.validated_output(t, graph) for t in fetch_names]
+        in_table = out_table = None
+    gdef = strip_and_freeze_upto(sess, graph, output_names)
+    return TFInputGraph(gdef, in_table, out_table, input_names, output_names)
+
+
+class _restored_checkpoint:
+    """Context manager: IsolatedSession with a checkpoint restored into it."""
+
+    def __init__(self, checkpoint_dir: str):
+        self.checkpoint_dir = checkpoint_dir
+
+    def __enter__(self):
+        tf = require_tf()
+        ckpt = tf.train.latest_checkpoint(self.checkpoint_dir)
+        if ckpt is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.checkpoint_dir!r}"
+            )
+        from tensorflow.python.framework import meta_graph as _mg
+
+        meta = _mg.read_meta_graph_file(ckpt + ".meta")
+        self._issn = IsolatedSession()
+        self._issn.__enter__()
+        try:
+            saver = tf.compat.v1.train.import_meta_graph(meta, clear_devices=True)
+            if saver is not None:
+                saver.restore(self._issn.sess, ckpt)
+        except BaseException:
+            self._issn.__exit__(None, None, None)
+            raise
+        return self._issn, meta
+
+    def __exit__(self, *exc):
+        return self._issn.__exit__(*exc)
+
+
+class _loaded_saved_model:
+    """Context manager: IsolatedSession with a SavedModel loaded into it."""
+
+    def __init__(self, saved_model_dir: str, tag_set: str):
+        self.saved_model_dir = saved_model_dir
+        self.tags = [t for t in (tag_set or "").split(",") if t]
+
+    def __enter__(self):
+        tf = require_tf()
+        self._issn = IsolatedSession()
+        self._issn.__enter__()
+        try:
+            meta = tf.compat.v1.saved_model.loader.load(
+                self._issn.sess, self.tags, self.saved_model_dir
+            )
+        except BaseException:
+            self._issn.__exit__(None, None, None)
+            raise
+        return self._issn, meta
+
+    def __exit__(self, *exc):
+        return self._issn.__exit__(*exc)

@@ -1,0 +1,74 @@
+"""GraphFunction / IsolatedSession surgery tests (SURVEY.md §4,
+[U: python/tests/graph/test_builder.py])."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax  # noqa: E402
+
+from sparkdl_tpu.graph import utils as tfx  # noqa: E402
+from sparkdl_tpu.graph.builder import GraphFunction, IsolatedSession  # noqa: E402
+
+
+def _linear_gfn(scale: float) -> GraphFunction:
+    with IsolatedSession() as issn:
+        x = tf.compat.v1.placeholder(tf.float32, [None, 3], name="x")
+        y = tf.identity(x * scale, name="y")
+        return issn.asGraphFunction([x], [y])
+
+
+def test_isolated_sessions_do_not_alias():
+    with IsolatedSession() as a:
+        tf.constant(1.0, name="only_in_a")
+        assert a.graph.get_operation_by_name("only_in_a") is not None
+    with IsolatedSession() as b:
+        with pytest.raises(Exception):
+            b.graph.get_operation_by_name("only_in_a")
+
+
+def test_graph_function_roundtrip(tmp_path):
+    gfn = _linear_gfn(2.0)
+    p = str(tmp_path / "fn.gfn")
+    gfn.dump(p)
+    loaded = GraphFunction.load(p)
+    assert loaded.input_names == gfn.input_names
+    assert loaded.output_names == gfn.output_names
+    x = np.ones((2, 3), np.float32)
+    (out,) = jax.jit(loaded.to_jax())(x)
+    np.testing.assert_allclose(np.asarray(out), x * 2.0)
+
+
+def test_import_graph_function_composes():
+    """Splice two GraphFunctions: y = (x*2)*3."""
+    double, triple = _linear_gfn(2.0), _linear_gfn(3.0)
+    with IsolatedSession() as issn:
+        x = tf.compat.v1.placeholder(tf.float32, [None, 3], name="x")
+        (i1,), (o1,) = issn.importGraphFunction(double, prefix="a")
+        (i2,), (o2,) = issn.importGraphFunction(triple, prefix="b")
+        # feed through: x -> double -> triple
+        composed = issn.run(
+            o2, {i2: issn.run(o1, {i1: np.ones((1, 3), np.float32)})}
+        )
+    np.testing.assert_allclose(composed, np.full((1, 3), 6.0))
+
+
+def test_freeze_prunes_dead_nodes():
+    with IsolatedSession() as issn:
+        x = tf.compat.v1.placeholder(tf.float32, [None, 2], name="x")
+        tf.identity(x * 100.0, name="dead_branch")
+        y = tf.identity(x + 1.0, name="y")
+        gfn = issn.asGraphFunction([x], [y])
+    names = {n.name for n in gfn.graph_def.node}
+    assert "dead_branch" not in names
+
+
+def test_name_utils():
+    assert tfx.op_name("a/b:0") == "a/b"
+    assert tfx.tensor_name("a/b") == "a/b:0"
+    assert tfx.tensor_name("a/b:1") == "a/b:1"
+    with pytest.raises(ValueError):
+        tfx.tensor_name("a:b:c")
+    with pytest.raises(ValueError):
+        tfx.tensor_name("a:x")

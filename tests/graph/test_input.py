@@ -1,0 +1,148 @@
+"""TFInputGraph ingestion tests — all six constructors against one oracle.
+
+Mirrors the reference's parametrized ingestion suite (SURVEY.md §4, [U:
+python/tests/graph/test_input.py]): build one small model, export it every
+way TF can, ingest each export, and assert the lowered JAX function matches
+the direct-session oracle on the same batch.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax  # noqa: E402
+
+from sparkdl_tpu.graph.builder import IsolatedSession  # noqa: E402
+from sparkdl_tpu.graph.input import TFInputGraph  # noqa: E402
+
+DIM = 4
+OUT = 3
+
+
+def _build_model():
+    """y = relu(x @ w + b) with variable weights, TF1-style graph."""
+    x = tf.compat.v1.placeholder(tf.float32, [None, DIM], name="x")
+    w = tf.compat.v1.get_variable(
+        "w", initializer=np.arange(DIM * OUT, dtype=np.float32).reshape(DIM, OUT)
+    )
+    b = tf.compat.v1.get_variable("b", initializer=np.ones(OUT, np.float32))
+    y = tf.identity(tf.nn.relu(tf.matmul(x, w) + b), name="y")
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def batch():
+    return np.random.default_rng(7).standard_normal((5, DIM)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def oracle(batch):
+    with IsolatedSession() as issn:
+        x, y = _build_model()
+        issn.run(tf.compat.v1.global_variables_initializer())
+        return issn.run(y, {x: batch})
+
+
+def _check(gin: TFInputGraph, batch, oracle):
+    fn = gin.to_jax()
+    (out,) = jax.jit(fn)(batch)
+    np.testing.assert_allclose(np.asarray(out), oracle, rtol=1e-5, atol=1e-5)
+
+
+def test_from_graph(batch, oracle):
+    with IsolatedSession() as issn:
+        _build_model()
+        issn.run(tf.compat.v1.global_variables_initializer())
+        gin = TFInputGraph.fromGraph(issn.graph, issn.sess, ["x"], ["y"])
+    _check(gin, batch, oracle)
+
+
+def test_from_graph_def(batch, oracle):
+    with IsolatedSession() as issn:
+        _build_model()
+        issn.run(tf.compat.v1.global_variables_initializer())
+        gin0 = TFInputGraph.fromGraph(issn.graph, issn.sess, ["x"], ["y:0"])
+    gin = TFInputGraph.fromGraphDef(gin0.graph_def, ["x:0"], ["y:0"])
+    _check(gin, batch, oracle)
+
+
+@pytest.fixture(scope="module")
+def checkpoint_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ckpt")
+    with IsolatedSession() as issn:
+        x, y = _build_model()
+        issn.run(tf.compat.v1.global_variables_initializer())
+        saver = tf.compat.v1.train.Saver()
+        path = saver.save(issn.sess, str(d / "model"))
+        # re-export the meta graph with a serving signature attached, so the
+        # same checkpoint serves both signature and non-signature tests
+        meta = saver.export_meta_graph()
+        sig = tf.compat.v1.saved_model.signature_def_utils.predict_signature_def(
+            {"input_sig": x}, {"output_sig": y}
+        )
+        meta.signature_def["serving_default"].CopyFrom(sig)
+        with open(path + ".meta", "wb") as f:
+            f.write(meta.SerializeToString())
+    return str(d)
+
+
+def test_from_checkpoint(checkpoint_dir, batch, oracle):
+    gin = TFInputGraph.fromCheckpoint(checkpoint_dir, ["x"], ["y"])
+    _check(gin, batch, oracle)
+
+
+def test_from_checkpoint_with_signature(checkpoint_dir, batch, oracle):
+    gin = TFInputGraph.fromCheckpointWithSignature(checkpoint_dir)
+    assert gin.input_tensor_name_from_signature == {"input_sig": "x:0"}
+    assert gin.output_tensor_name_from_signature == {"output_sig": "y:0"}
+    _check(gin, batch, oracle)
+
+
+@pytest.fixture(scope="module")
+def saved_model_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("savedmodel") / "model"
+    with IsolatedSession() as issn:
+        x, y = _build_model()
+        issn.run(tf.compat.v1.global_variables_initializer())
+        builder = tf.compat.v1.saved_model.Builder(str(d))
+        sig = tf.compat.v1.saved_model.signature_def_utils.predict_signature_def(
+            {"input_sig": x}, {"output_sig": y}
+        )
+        builder.add_meta_graph_and_variables(
+            issn.sess, ["serve"], signature_def_map={"serving_default": sig}
+        )
+        builder.save()
+    return str(d)
+
+
+def test_from_saved_model(saved_model_dir, batch, oracle):
+    gin = TFInputGraph.fromSavedModel(
+        saved_model_dir, tag_set="serve", feed_names=["x"], fetch_names=["y"]
+    )
+    _check(gin, batch, oracle)
+
+
+def test_from_saved_model_with_signature(saved_model_dir, batch, oracle):
+    gin = TFInputGraph.fromSavedModelWithSignature(saved_model_dir)
+    _check(gin, batch, oracle)
+
+
+def test_translate_mappings(saved_model_dir):
+    gin = TFInputGraph.fromSavedModelWithSignature(saved_model_dir)
+    assert gin.translateInputMapping({"features": "input_sig"}) == {
+        "features": "x:0"
+    }
+    assert gin.translateOutputMapping({"output_sig": "preds"}) == {
+        "y:0": "preds"
+    }
+    with pytest.raises(KeyError):
+        gin.translateInputMapping({"features": "nope"})
+
+
+def test_non_placeholder_input_rejected():
+    with IsolatedSession() as issn:
+        _build_model()
+        issn.run(tf.compat.v1.global_variables_initializer())
+        with pytest.raises(ValueError, match="Placeholder"):
+            TFInputGraph.fromGraph(issn.graph, issn.sess, ["y"], ["y"])

@@ -1,0 +1,54 @@
+"""Image-converter piece tests (SURVEY.md §4, [U: python/tests/graph/
+test_pieces.py]): TF piece and JAX twin agree with each other and with a
+numpy oracle on BGR→RGB + cast."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from sparkdl_tpu.graph.builder import IsolatedSession  # noqa: E402
+from sparkdl_tpu.graph.pieces import (  # noqa: E402
+    buildSpImageConverter,
+    image_batch_to_float,
+)
+
+
+@pytest.fixture(scope="module")
+def bgr_image(rng=None):
+    return np.random.default_rng(3).integers(0, 256, (5, 4, 3), dtype=np.uint8)
+
+
+def _run_piece(gfn, img: np.ndarray) -> np.ndarray:
+    h, w, c = img.shape
+    with IsolatedSession() as issn:
+        ins, outs = issn.importGraphFunction(gfn)
+        feed = dict(zip(ins, [h, w, c, img.tobytes()]))
+        return issn.run(outs[0], feed)
+
+
+def test_sp_image_converter_bgr(bgr_image):
+    gfn = buildSpImageConverter(channelOrder="BGR")
+    out = _run_piece(gfn, bgr_image)
+    expected = bgr_image[..., ::-1].astype(np.float32)
+    np.testing.assert_allclose(out, expected)
+
+
+def test_sp_image_converter_rgb_passthrough(bgr_image):
+    gfn = buildSpImageConverter(channelOrder="RGB")
+    out = _run_piece(gfn, bgr_image)
+    np.testing.assert_allclose(out, bgr_image.astype(np.float32))
+
+
+def test_jax_twin_matches_tf_piece(bgr_image):
+    gfn = buildSpImageConverter(channelOrder="BGR")
+    tf_out = _run_piece(gfn, bgr_image)
+    jax_out = np.asarray(image_batch_to_float(bgr_image[None], "BGR"))[0]
+    np.testing.assert_allclose(jax_out, tf_out)
+
+
+def test_invalid_args_rejected():
+    with pytest.raises(ValueError):
+        buildSpImageConverter(channelOrder="HSV")
+    with pytest.raises(ValueError):
+        buildSpImageConverter(img_dtype="int64")
