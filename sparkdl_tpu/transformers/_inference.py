@@ -9,7 +9,9 @@ jit's shape-keyed cache means each bucket size compiles exactly once.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Iterator, Sequence
+import logging
+import weakref
+from typing import Any, Callable, Iterator
 
 import jax
 import numpy as np
@@ -35,7 +37,11 @@ class BatchedRunner:
         self._buckets = default_buckets(self.batch_size)
 
     def run(self, rows: Iterator[dict[str, np.ndarray]]) -> Iterator[np.ndarray]:
-        """Yield one output array per input row, in order."""
+        """Yield one output per input row, in order.
+
+        Single-array apply_fns yield arrays; tuple-valued apply_fns (e.g.
+        multi-output ingested graphs) yield per-row tuples.
+        """
         batches = rebatch(rows, self.batch_size, self._buckets)
         # keep (n_valid) alongside the device computation
         metas: list[int] = []
@@ -49,11 +55,33 @@ class BatchedRunner:
             device_batches(), size=self.prefetch, transfer=self._transfer
         )
         for i, out in enumerate(map(self._jitted, results)):
-            out = np.asarray(out)
-            yield from out[: metas[i]]
+            n = metas[i]
+            if isinstance(out, (tuple, list)):
+                arrays = [np.asarray(o) for o in out]
+                for j in range(n):
+                    yield tuple(a[j] for a in arrays)
+            else:
+                yield from np.asarray(out)[:n]
 
     def _transfer(self, arrays: dict[str, np.ndarray]):
         return jax.device_put(arrays)
+
+
+#: graph object -> {cache key: BatchedRunner}; weak so graphs can be GC'd.
+_GRAPH_RUNNERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def cached_graph_runner(graph, key, make_apply_fn: Callable[[], Callable],
+                        batch_size: int) -> BatchedRunner:
+    """Process-wide BatchedRunner cache keyed by (graph identity, key).
+
+    One jax.jit per (ingested graph, shape/batch config) no matter how many
+    partitions, transformer copies, or transformer classes touch it.
+    """
+    per_graph = _GRAPH_RUNNERS.setdefault(graph, {})
+    if key not in per_graph:
+        per_graph[key] = BatchedRunner(make_apply_fn(), batch_size=batch_size)
+    return per_graph[key]
 
 
 def run_partition_with_passthrough(
@@ -62,20 +90,38 @@ def run_partition_with_passthrough(
     runner: BatchedRunner,
     output_col: str,
     postprocess: Callable[[np.ndarray], Any] | None = None,
+    input_cols: "tuple[str, ...] | None" = None,
 ) -> Iterator[dict]:
     """Run inference for a partition, appending ``output_col`` to each row.
 
     ``extract`` turns a row into the numeric feature dict the model eats;
     rows it raises on are yielded unchanged with output None (mirrors the
-    reference's tolerance of undecodable rows).
+    reference's tolerance of undecodable rows). Misconfiguration stays loud
+    rather than masked as bad data: missing ``input_cols`` raise
+    immediately, and an all-rows-failed partition logs a warning with the
+    first error.
     """
+    if rows and input_cols:
+        missing = [c for c in input_cols if c not in rows[0]]
+        if missing:
+            raise KeyError(
+                f"input column(s) {missing} not in DataFrame columns "
+                f"{sorted(rows[0].keys())}"
+            )
     feeds: list[dict[str, np.ndarray] | None] = []
+    first_error: Exception | None = None
     for r in rows:
         try:
             feeds.append(extract(r))
-        except Exception:
+        except Exception as e:
+            first_error = first_error or e
             feeds.append(None)
     valid = [f for f in feeds if f is not None]
+    if rows and not valid and first_error is not None:
+        logging.getLogger(__name__).warning(
+            "all %d rows in partition failed extraction (output=None); "
+            "first error: %r", len(rows), first_error,
+        )
     outputs = runner.run(iter(valid)) if valid else iter(())
     for r, f in zip(rows, feeds):
         out_row = dict(r)
@@ -85,11 +131,3 @@ def run_partition_with_passthrough(
             o = next(outputs)
             out_row[output_col] = postprocess(o) if postprocess else o
         yield out_row
-
-
-def uniform_shape(arrays: Sequence[np.ndarray]) -> "tuple | None":
-    """The common shape of a list of arrays, or None if ragged."""
-    if not arrays:
-        return None
-    s = arrays[0].shape
-    return s if all(a.shape == s for a in arrays[1:]) else None

@@ -60,6 +60,16 @@ def _load_keras_predictor(model_file: str, mtime: float):
     return apply_np, False
 
 
+@functools.lru_cache(maxsize=16)
+def _keras_runner(model_file: str, mtime: float, batch_size: int):
+    """Per-process runner cache: one jax.jit per (model file, batch size),
+    shared across partitions so XLA compiles each bucket exactly once."""
+    apply_fn, jittable = _load_keras_predictor(model_file, mtime)
+    if jittable:
+        return BatchedRunner(apply_fn, batch_size=batch_size)
+    return _EagerRunner(apply_fn, batch_size)
+
+
 class KerasTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
     modelFile = Param(
         None, "modelFile", "path to the Keras model (.h5 or .keras)",
@@ -90,11 +100,7 @@ class KerasTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
             rows = list(rows)
             if not rows:
                 return iter(())
-            apply_fn, jittable = _load_keras_predictor(model_file, mtime)
-            if jittable:
-                runner = BatchedRunner(apply_fn, batch_size=batch_size)
-            else:
-                runner = _EagerRunner(apply_fn, batch_size)
+            runner = _keras_runner(model_file, mtime, batch_size)
 
             def extract(row):
                 arr = np.asarray(row[input_col], dtype=np.float32)
@@ -107,6 +113,7 @@ class KerasTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
             return run_partition_with_passthrough(
                 rows, extract, runner, output_col,
                 lambda o: np.asarray(o, dtype=np.float32),
+                input_cols=(input_col,),
             )
 
         return transform_partitions(
@@ -115,15 +122,28 @@ class KerasTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
 
 
 class _EagerRunner:
-    """BatchedRunner-shaped wrapper for non-jittable backends."""
+    """BatchedRunner-shaped wrapper for non-jittable backends.
+
+    No bucket padding: padding exists to protect jit's shape-keyed compile
+    cache, which the eager path doesn't have — tails run at natural size.
+    """
 
     def __init__(self, apply_fn, batch_size: int):
         self.apply_fn = apply_fn
         self.batch_size = batch_size
 
     def run(self, rows):
-        from sparkdl_tpu.runtime.batching import rebatch
+        pending = []
+        for r in rows:
+            pending.append(r)
+            if len(pending) == self.batch_size:
+                yield from self._flush(pending)
+                pending = []
+        if pending:
+            yield from self._flush(pending)
 
-        for b in rebatch(rows, self.batch_size, (self.batch_size,)):
-            out = np.asarray(self.apply_fn(b.arrays))
-            yield from out[: b.n_valid]
+    def _flush(self, pending):
+        arrays = {
+            k: np.stack([r[k] for r in pending]) for k in pending[0].keys()
+        }
+        yield from np.asarray(self.apply_fn(arrays))

@@ -36,12 +36,45 @@ from sparkdl_tpu.transformers._inference import (
 )
 
 
+def _weights_token(weights: "str | None") -> float:
+    """Cache-key component so a replaced weights file is never served stale."""
+    import os
+
+    if weights is not None and os.path.isfile(weights):
+        return os.path.getmtime(weights)
+    return 0.0
+
+
 @functools.lru_cache(maxsize=8)
-def _load_named_model(model_name: str, weights: "str | None", include_top: bool):
+def _load_named_model(model_name: str, weights: "str | None", include_top: bool,
+                      weights_token: float = 0.0):
     """Per-process cache so Spark executors build each model once."""
     from sparkdl_tpu.models.registry import build_flax_model
 
     return build_flax_model(model_name, weights=weights, include_top=include_top)
+
+
+@functools.lru_cache(maxsize=16)
+def _named_model_runner(
+    model_name: str, weights: "str | None", include_top: bool,
+    head: str, batch_size: int, weights_token: float = 0.0,
+) -> BatchedRunner:
+    """Per-process runner cache: one jax.jit per (model, head, batch size).
+
+    Partitions rebuild closures, so caching the BatchedRunner (not just the
+    model) is what keeps XLA from recompiling the network per partition.
+    """
+    module, variables = _load_named_model(
+        model_name, weights, include_top, weights_token
+    )
+    preprocess = PREPROCESSORS[get_entry(model_name).preprocess]
+
+    def apply_fn(batch):
+        x = preprocess(batch["img"])
+        features, probs = module.apply(variables, x, train=False)
+        return features if head == "features" else probs
+
+    return BatchedRunner(apply_fn, batch_size=batch_size)
 
 
 def _resize_host(arr: np.ndarray, size: tuple[int, int]) -> np.ndarray:
@@ -50,12 +83,12 @@ def _resize_host(arr: np.ndarray, size: tuple[int, int]) -> np.ndarray:
     from PIL import Image
 
     h, w = size
+    if arr.shape[-1] == 1:  # grayscale -> 3-channel, whatever the size
+        arr = np.repeat(arr, 3, axis=-1)
     if arr.shape[:2] == (h, w):
         return arr.astype(np.float32)
     if arr.dtype != np.uint8:
         arr = np.clip(arr, 0, 255).astype(np.uint8)
-    if arr.shape[-1] == 1:
-        arr = np.repeat(arr, 3, axis=-1)
     img = Image.fromarray(arr).resize((w, h), Image.BILINEAR)
     return np.asarray(img, dtype=np.float32)
 
@@ -104,9 +137,8 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSiz
     def getModelName(self) -> str:
         return self.getOrDefault("modelName")
 
-    # subclasses pick which head of (features, probs) to emit
-    def _select_output(self, features, probs):  # pragma: no cover - abstract
-        raise NotImplementedError
+    #: which head of (features, probs) the subclass emits
+    _head: str = "probs"
 
     def _postprocess(self, out: np.ndarray):
         return out
@@ -121,32 +153,27 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSiz
         input_col = self.getInputCol()
         output_col = self.getOutputCol()
         include_top = self._include_top
-        select_output = self._select_output
+        head = self._head
         postprocess = self._postprocess
 
-        entry = get_entry(model_name)
-        size = entry.input_size
-        preprocess = PREPROCESSORS[entry.preprocess]
+        size = get_entry(model_name).input_size
 
         def partition_fn(rows):
             rows = list(rows)
             if not rows:
                 return iter(())
-            module, variables = _load_named_model(model_name, weights, include_top)
-
-            def apply_fn(batch):
-                x = preprocess(batch["img"])
-                features, probs = module.apply(variables, x, train=False)
-                return select_output(features, probs)
-
-            runner = BatchedRunner(apply_fn, batch_size=batch_size)
+            runner = _named_model_runner(
+                model_name, weights, include_top, head, batch_size,
+                _weights_token(weights),
+            )
 
             def extract(row):
                 arr = _image_to_rgb_array(row[input_col])
                 return {"img": _resize_host(arr, size)}
 
             return run_partition_with_passthrough(
-                rows, extract, runner, output_col, postprocess
+                rows, extract, runner, output_col, postprocess,
+                input_cols=(input_col,),
             )
 
         return transform_partitions(dataset, partition_fn, self._output_schema())
@@ -160,9 +187,7 @@ class DeepImageFeaturizer(_NamedImageTransformer):
     """
 
     _include_top = False
-
-    def _select_output(self, features, probs):
-        return features
+    _head = "features"
 
     def _postprocess(self, out):
         return np.asarray(out, dtype=np.float32)
@@ -187,9 +212,6 @@ class DeepImagePredictor(_NamedImageTransformer):
         super().__init__(inputCol, outputCol, modelName, batchSize, weights)
         self._setDefault(decodePredictions=False, topK=5)
         self._set(decodePredictions=decodePredictions, topK=topK)
-
-    def _select_output(self, features, probs):
-        return probs
 
     def _postprocess(self, out):
         probs = np.asarray(out, dtype=np.float32)

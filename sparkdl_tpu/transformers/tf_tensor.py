@@ -1,0 +1,129 @@
+"""TFTransformer — run an ingested TF graph over numeric DataFrame columns.
+
+Reference parity (SURVEY.md 2.6, [U: python/sparkdl/transformers/
+tf_tensor.py]): takes a ``TFInputGraph`` plus explicit input/output
+tensor↔column mappings, and applies the graph per partition block. The
+reference strips/optimizes the graph and ships it to the executor JVM's TF
+session; here the frozen graph is XLA-lowered once (TFInputGraph.to_jax) and
+driven by the shared bucketed/prefetched runner, so it fuses and runs on TPU
+like native JAX code.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from sparkdl_tpu.dataframe import transform_partitions
+from sparkdl_tpu.graph.builder import placeholder_specs
+from sparkdl_tpu.graph.input import TFInputGraph
+from sparkdl_tpu.param import (
+    HasBatchSize,
+    Param,
+    SparkDLTypeConverters,
+    Transformer,
+)
+from sparkdl_tpu.transformers._inference import cached_graph_runner
+
+
+def _graph_runner(gin: TFInputGraph, batch_size: int):
+    def make_apply_fn():
+        fn = gin.to_jax()
+        names = list(gin.input_names)
+        return lambda batch: fn(*(batch[n] for n in names))
+
+    return cached_graph_runner(gin, batch_size, make_apply_fn, batch_size)
+
+
+class TFTransformer(Transformer, HasBatchSize):
+    tfInputGraph = Param(
+        None, "tfInputGraph", "ingested TF graph (TFInputGraph)",
+        SparkDLTypeConverters.toTFInputGraph,
+    )
+    inputMapping = Param(
+        None, "inputMapping",
+        "dict: input column -> graph input (tensor name or signature key)",
+        SparkDLTypeConverters.toColumnToTensorNameMap,
+    )
+    outputMapping = Param(
+        None, "outputMapping",
+        "dict: graph output (tensor name or signature key) -> output column",
+        SparkDLTypeConverters.toTensorNameToColumnMap,
+    )
+
+    def __init__(self, tfInputGraph=None, inputMapping=None, outputMapping=None,
+                 batchSize=None):
+        super().__init__()
+        self._setDefault(batchSize=256)
+        self._set(tfInputGraph=tfInputGraph, inputMapping=inputMapping,
+                  outputMapping=outputMapping, batchSize=batchSize)
+
+    def getTFInputGraph(self) -> TFInputGraph:
+        return self.getOrDefault("tfInputGraph")
+
+    def getInputMapping(self) -> dict:
+        return self.getOrDefault("inputMapping")
+
+    def getOutputMapping(self) -> dict:
+        return self.getOrDefault("outputMapping")
+
+    def _transform(self, dataset):
+        gin = self.getTFInputGraph()
+        batch_size = self.getBatchSize()
+
+        # column -> canonical input tensor name (signature keys resolved)
+        col_to_tensor = gin.translateInputMapping(self.getInputMapping())
+        # canonical output tensor name -> column
+        tensor_to_col = gin.translateOutputMapping(self.getOutputMapping())
+
+        tensor_to_colin = {t: c for c, t in col_to_tensor.items()}
+        missing = [t for t in gin.input_names if t not in tensor_to_colin]
+        if missing:
+            raise ValueError(
+                f"inputMapping covers no column for graph inputs {missing}; "
+                f"graph inputs are {gin.input_names}"
+            )
+        # ordered column feed matching gin.input_names / to_jax arg order
+        feed_cols = [tensor_to_colin[t] for t in gin.input_names]
+
+        out_indices, out_cols = [], []
+        for t, col in tensor_to_col.items():
+            if t not in gin.output_names:
+                raise ValueError(
+                    f"outputMapping names {t!r}, not a graph output "
+                    f"{gin.output_names}"
+                )
+            out_indices.append(gin.output_names.index(t))
+            out_cols.append(col)
+
+        in_dtypes = [
+            s.dtype.as_numpy_dtype
+            for s in placeholder_specs(gin.graph_def, gin.input_names)
+        ]
+
+        def partition_fn(rows) -> Iterator[dict]:
+            rows = list(rows)
+            if not rows:
+                return iter(())
+            runner = _graph_runner(gin, batch_size)
+
+            def feeds():
+                for r in rows:
+                    yield {
+                        t: np.asarray(r[c], dtype=dt)
+                        for t, c, dt in zip(gin.input_names, feed_cols, in_dtypes)
+                    }
+
+            def emit():
+                outputs = runner.run(feeds())
+                for r, out in zip(rows, outputs):
+                    new = dict(r)
+                    for idx, col in zip(out_indices, out_cols):
+                        new[col] = np.asarray(out[idx], dtype=np.float32)
+                    yield new
+
+            return emit()
+
+        schema = [(c, "array<float>") for c in out_cols]
+        return transform_partitions(dataset, partition_fn, schema)

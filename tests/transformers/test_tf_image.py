@@ -1,0 +1,102 @@
+"""TFImageTransformer tests (SURVEY.md §4, [U: python/tests/transformers/
+tf_image_test.py]): user graph over the image column, vector and image
+output modes, with a direct-session oracle."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from sparkdl_tpu import TFImageTransformer  # noqa: E402
+from sparkdl_tpu.dataframe.local import LocalDataFrame  # noqa: E402
+from sparkdl_tpu.graph.builder import IsolatedSession  # noqa: E402
+from sparkdl_tpu.graph.input import TFInputGraph  # noqa: E402
+from sparkdl_tpu.image.imageIO import imageArrayToStructBGR, imageStructToArray  # noqa: E402
+
+H = W = 8
+
+
+def _image_rows(n=6, size=(H, W)):
+    rng = np.random.default_rng(5)
+    rows = []
+    for i in range(n):
+        rgb = rng.integers(0, 256, (*size, 3), dtype=np.uint8)
+        rows.append({"i": i, "image": imageArrayToStructBGR(rgb)})
+    return rows
+
+
+@pytest.fixture(scope="module")
+def mean_graph():
+    """Graph: batched image -> per-channel spatial mean (rank-4 input)."""
+    with IsolatedSession() as issn:
+        x = tf.compat.v1.placeholder(tf.float32, [None, H, W, 3], name="img_in")
+        y = tf.identity(tf.reduce_mean(x, axis=[1, 2]), name="means")
+        gin = TFInputGraph.fromGraph(issn.graph, issn.sess, ["img_in"], ["means"])
+    return gin
+
+
+def test_vector_mode_matches_numpy_oracle(mean_graph):
+    rows = _image_rows()
+    df = LocalDataFrame.from_rows(rows, num_partitions=2)
+    out = TFImageTransformer(
+        inputCol="image", outputCol="v", graph=mean_graph, batchSize=4
+    ).transform(df).collect()
+    for r_in, r_out in zip(rows, out):
+        rgb = imageStructToArray(r_in["image"])[..., ::-1].astype(np.float32)
+        np.testing.assert_allclose(
+            r_out["v"], rgb.mean(axis=(0, 1)), rtol=1e-5, atol=1e-4
+        )
+
+
+def test_image_output_mode(mean_graph):
+    with IsolatedSession() as issn:
+        x = tf.compat.v1.placeholder(tf.float32, [None, H, W, 3], name="img_in")
+        y = tf.identity(255.0 - x, name="inverted")
+        gin = TFInputGraph.fromGraph(issn.graph, issn.sess, ["img_in"], ["inverted"])
+    rows = _image_rows()
+    df = LocalDataFrame.from_rows(rows)
+    out = TFImageTransformer(
+        inputCol="image", outputCol="inv", graph=gin, outputMode="image"
+    ).transform(df).collect()
+    for r_in, r_out in zip(rows, out):
+        inv = r_out["inv"]
+        assert inv["height"] == H and inv["nChannels"] == 3
+        rgb_in = imageStructToArray(r_in["image"])[..., ::-1].astype(np.float32)
+        rgb_out = imageStructToArray(inv)[..., ::-1]
+        np.testing.assert_allclose(rgb_out, 255.0 - rgb_in, atol=1e-4)
+
+
+def test_rank3_graph_per_row():
+    with IsolatedSession() as issn:
+        x = tf.compat.v1.placeholder(tf.float32, [H, W, 3], name="one")
+        y = tf.identity(tf.reduce_max(x, axis=[0, 1]), name="mx")
+        gin = TFInputGraph.fromGraph(issn.graph, issn.sess, ["one"], ["mx"])
+    rows = _image_rows(3)
+    df = LocalDataFrame.from_rows(rows)
+    out = TFImageTransformer(
+        inputCol="image", outputCol="mx", graph=gin
+    ).transform(df).collect()
+    for r_in, r_out in zip(rows, out):
+        rgb = imageStructToArray(r_in["image"])[..., ::-1].astype(np.float32)
+        np.testing.assert_allclose(r_out["mx"], rgb.max(axis=(0, 1)), atol=1e-4)
+
+
+def test_resize_to_static_shape(mean_graph):
+    """Images at the wrong size get host-resized to the graph's (H, W)."""
+    rows = _image_rows(4, size=(2 * H, 2 * W))
+    df = LocalDataFrame.from_rows(rows)
+    out = TFImageTransformer(
+        inputCol="image", outputCol="v", graph=mean_graph
+    ).transform(df).collect()
+    assert all(r["v"] is not None and len(r["v"]) == 3 for r in out)
+
+
+def test_multi_io_graph_rejected():
+    with IsolatedSession() as issn:
+        a = tf.compat.v1.placeholder(tf.float32, [None, H, W, 3], name="a")
+        b = tf.compat.v1.placeholder(tf.float32, [None, H, W, 3], name="b")
+        y = tf.identity(a + b, name="y")
+        gin = TFInputGraph.fromGraph(issn.graph, issn.sess, ["a", "b"], ["y"])
+    df = LocalDataFrame.from_rows(_image_rows(2))
+    with pytest.raises(ValueError, match="single-input"):
+        TFImageTransformer(inputCol="image", outputCol="o", graph=gin).transform(df)

@@ -1,0 +1,118 @@
+"""TFTransformer tests — ingested-graph inference over numeric columns,
+parametrized across ingestion modes with a direct-session oracle
+(SURVEY.md §4, [U: python/tests/transformers/tf_tensor_test.py])."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from sparkdl_tpu import TFTransformer  # noqa: E402
+from sparkdl_tpu.dataframe.local import LocalDataFrame  # noqa: E402
+from sparkdl_tpu.graph.builder import IsolatedSession  # noqa: E402
+from sparkdl_tpu.graph.input import TFInputGraph  # noqa: E402
+
+DIM = 6
+
+
+def _model():
+    x = tf.compat.v1.placeholder(tf.float32, [None, DIM], name="x")
+    w = tf.compat.v1.get_variable(
+        "w", initializer=np.linspace(-1, 1, DIM * 2, dtype=np.float32).reshape(DIM, 2)
+    )
+    y = tf.identity(tf.nn.sigmoid(x @ w), name="y")
+    z = tf.identity(tf.reduce_sum(x, axis=1, keepdims=True), name="z")
+    return x, y, z
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    return [
+        {"idx": i, "feat": rng.standard_normal(DIM).astype(np.float32)}
+        for i in range(13)
+    ]
+
+
+@pytest.fixture(scope="module")
+def gin_and_oracle(data):
+    with IsolatedSession() as issn:
+        x, y, z = _model()
+        issn.run(tf.compat.v1.global_variables_initializer())
+        gin = TFInputGraph.fromGraph(issn.graph, issn.sess, ["x"], ["y", "z"])
+        batch = np.stack([r["feat"] for r in data])
+        oracle_y, oracle_z = issn.run([y, z], {x: batch})
+    return gin, oracle_y, oracle_z
+
+
+def test_single_output(gin_and_oracle, data):
+    gin, oracle_y, _ = gin_and_oracle
+    df = LocalDataFrame.from_rows(data, num_partitions=3)
+    out = TFTransformer(
+        tfInputGraph=gin,
+        inputMapping={"feat": "x"},
+        outputMapping={"y": "preds"},
+        batchSize=4,
+    ).transform(df).collect()
+    got = np.stack([r["preds"] for r in out])
+    np.testing.assert_allclose(got, oracle_y, rtol=1e-5, atol=1e-6)
+    assert all("feat" in r and "idx" in r for r in out)  # passthrough
+
+
+def test_multi_output(gin_and_oracle, data):
+    gin, oracle_y, oracle_z = gin_and_oracle
+    df = LocalDataFrame.from_rows(data, num_partitions=2)
+    out = TFTransformer(
+        tfInputGraph=gin,
+        inputMapping={"feat": "x"},
+        outputMapping={"y": "preds", "z": "sums"},
+    ).transform(df).collect()
+    np.testing.assert_allclose(
+        np.stack([r["preds"] for r in out]), oracle_y, rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.stack([r["sums"] for r in out]), oracle_z, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_signature_keys(data):
+    with IsolatedSession() as issn:
+        x, y, _ = _model()
+        issn.run(tf.compat.v1.global_variables_initializer())
+        batch = np.stack([r["feat"] for r in data])
+        oracle = issn.run(y, {x: batch})
+        # fake a signature by building the tables directly via SavedModel
+        import tempfile
+
+        d = tempfile.mkdtemp() + "/sm"
+        builder = tf.compat.v1.saved_model.Builder(d)
+        sig = tf.compat.v1.saved_model.signature_def_utils.predict_signature_def(
+            {"features_in": x}, {"preds_out": y}
+        )
+        builder.add_meta_graph_and_variables(
+            issn.sess, ["serve"], signature_def_map={"serving_default": sig}
+        )
+        builder.save()
+    gin = TFInputGraph.fromSavedModelWithSignature(d)
+    df = LocalDataFrame.from_rows(data, num_partitions=2)
+    out = TFTransformer(
+        tfInputGraph=gin,
+        inputMapping={"feat": "features_in"},
+        outputMapping={"preds_out": "preds"},
+    ).transform(df).collect()
+    np.testing.assert_allclose(
+        np.stack([r["preds"] for r in out]), oracle, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_bad_mappings_rejected(gin_and_oracle, data):
+    gin, *_ = gin_and_oracle
+    df = LocalDataFrame.from_rows(data)
+    with pytest.raises(ValueError, match="not a graph output"):
+        TFTransformer(
+            tfInputGraph=gin,
+            inputMapping={"feat": "x"},
+            outputMapping={"nope": "preds"},
+        ).transform(df)
+    with pytest.raises(TypeError):
+        TFTransformer(tfInputGraph="not a graph")
