@@ -1,0 +1,3 @@
+from sparkdl_tpu.estimators.keras_image_file_estimator import KerasImageFileEstimator
+
+__all__ = ["KerasImageFileEstimator"]

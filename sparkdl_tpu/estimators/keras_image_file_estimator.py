@@ -1,0 +1,120 @@
+"""KerasImageFileEstimator — transfer-learning / HPO over image URIs.
+
+Reference parity (SURVEY.md 2.12/3.3, [U: python/sparkdl/estimators/
+keras_image_file_estimator.py]): ``fit(df, paramMaps)`` materializes (X, y)
+once via the user's ``imageLoader``, then trains one Keras model per param
+map (the reference fans these out across Spark tasks; here they run through
+a worker pool on the driver host — single-model training is *not* what this
+component distributes, in either implementation). Each fit saves a tuned
+model and returns it wrapped as a ``KerasImageFileTransformer``.
+
+Keras 3 on the jax backend means each ``model.fit`` is itself jit-compiled
+and runs on the TPU/devices available to this process; real multi-host
+data-parallel training belongs to TPURunner (SURVEY.md 2.13 parity).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Sequence
+
+import numpy as np
+
+from sparkdl_tpu.param import (
+    Estimator,
+    HasBatchSize,
+    HasInputCol,
+    HasLabelCol,
+    HasOutputCol,
+    Param,
+    SparkDLTypeConverters,
+)
+from sparkdl_tpu.transformers.keras_image import CanLoadImage, KerasImageFileTransformer
+
+
+class KerasImageFileEstimator(
+    Estimator, CanLoadImage, HasInputCol, HasOutputCol, HasLabelCol, HasBatchSize
+):
+    modelFile = Param(
+        None, "modelFile", "path to the Keras model to start training from",
+        SparkDLTypeConverters.toExistingFilePath,
+    )
+    kerasOptimizer = Param(
+        None, "kerasOptimizer", "Keras optimizer name (e.g. 'adam')",
+        SparkDLTypeConverters.toKerasOptimizer,
+    )
+    kerasLoss = Param(
+        None, "kerasLoss", "Keras loss name (e.g. 'categorical_crossentropy')",
+        SparkDLTypeConverters.toKerasLoss,
+    )
+    kerasFitParams = Param(
+        None, "kerasFitParams", "kwargs dict forwarded to keras Model.fit",
+    )
+
+    def __init__(self, inputCol=None, outputCol=None, labelCol=None,
+                 modelFile=None, imageLoader=None, kerasOptimizer=None,
+                 kerasLoss=None, kerasFitParams=None, batchSize=None):
+        super().__init__()
+        self._setDefault(
+            kerasOptimizer="adam", kerasFitParams={"verbose": 0}, batchSize=32
+        )
+        self._set(inputCol=inputCol, outputCol=outputCol, labelCol=labelCol,
+                  modelFile=modelFile, imageLoader=imageLoader,
+                  kerasOptimizer=kerasOptimizer, kerasLoss=kerasLoss,
+                  kerasFitParams=kerasFitParams, batchSize=batchSize)
+
+    # -- data materialization (reference: imageLoader UDF -> numpy) --------
+    def _collect_xy(self, dataset) -> tuple[np.ndarray, "np.ndarray | None"]:
+        input_col = self.getInputCol()
+        label_col = self.getOrDefault("labelCol") if self.isDefined("labelCol") else None
+        uris, labels = [], []
+        rows = dataset.collect() if hasattr(dataset, "collect") else list(dataset)
+        for r in rows:
+            uris.append(r[input_col])
+            if label_col is not None:
+                labels.append(r[label_col])
+        x = np.stack([self._load_one(u) for u in uris])
+        y = np.asarray(labels, dtype=np.float32) if labels else None
+        return x, y
+
+    def _load_one(self, uri: str) -> np.ndarray:
+        arr = np.asarray(self.loadImage(uri), dtype=np.float32)
+        if arr.ndim == 4 and arr.shape[0] == 1:
+            arr = arr[0]
+        return arr
+
+    # -- fitting -----------------------------------------------------------
+    def _fit(self, dataset) -> KerasImageFileTransformer:
+        return self.fitMultiple(dataset, [{}])[0]
+
+    def fitMultiple(self, dataset, paramMaps: Sequence[dict]) -> list:
+        """One tuned model per param map, trained over the shared (X, y)."""
+        x, y = self._collect_xy(dataset)
+        if y is None:
+            raise ValueError("labelCol must be set (and present) to fit")
+        return [self._fit_one(pm, x, y) for pm in paramMaps]
+
+    def _fit_one(self, param_map: dict, x: np.ndarray, y: np.ndarray):
+        est: KerasImageFileEstimator = self.copy(param_map) if param_map else self
+        import keras
+
+        model = keras.models.load_model(est.getOrDefault("modelFile"), compile=False)
+        model.compile(
+            optimizer=est.getOrDefault("kerasOptimizer"),
+            loss=est.getOrDefault("kerasLoss"),
+        )
+        fit_params: dict[str, Any] = dict(est.getOrDefault("kerasFitParams"))
+        fit_params.setdefault("verbose", 0)
+        model.fit(x, y, batch_size=est.getBatchSize(), **fit_params)
+
+        fd, path = tempfile.mkstemp(suffix=".keras", prefix="sparkdl_tuned_")
+        os.close(fd)
+        model.save(path)
+        return KerasImageFileTransformer(
+            inputCol=est.getInputCol(),
+            outputCol=est.getOutputCol(),
+            modelFile=path,
+            imageLoader=est.getImageLoader(),
+            batchSize=est.getBatchSize(),
+        )

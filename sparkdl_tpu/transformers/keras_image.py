@@ -1,0 +1,100 @@
+"""KerasImageFileTransformer — Keras inference over a column of image URIs.
+
+Reference parity (SURVEY.md 2.4, [U: python/sparkdl/transformers/
+keras_image.py]): a user-supplied ``imageLoader(uri) -> np.ndarray`` runs per
+row (load + preprocess to the model's input shape), then the Keras model
+scores the loaded batch. The model executes natively on JAX (Keras 3 jax
+backend) through the shared bucketed/prefetched runner.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sparkdl_tpu.dataframe import transform_partitions
+from sparkdl_tpu.param import (
+    HasBatchSize,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    SparkDLTypeConverters,
+    Transformer,
+)
+from sparkdl_tpu.transformers._inference import run_partition_with_passthrough
+from sparkdl_tpu.transformers.keras_tensor import _keras_runner
+
+
+class CanLoadImage:
+    """Mixin: the ``imageLoader`` param shared by the image-file APIs
+    ([U: python/sparkdl/param/image_params.py] CanLoadImage)."""
+
+    imageLoader = Param(
+        None, "imageLoader",
+        "callable uri -> np.ndarray loading and preprocessing one image",
+    )
+
+    def getImageLoader(self):
+        return self.getOrDefault("imageLoader")
+
+    def loadImage(self, uri: str) -> np.ndarray:
+        loader = self.getImageLoader()
+        if loader is None:
+            raise ValueError("imageLoader is not set")
+        return np.asarray(loader(uri))
+
+
+class KerasImageFileTransformer(
+    Transformer, CanLoadImage, HasInputCol, HasOutputCol, HasBatchSize
+):
+    modelFile = Param(
+        None, "modelFile", "path to the Keras model (.h5 or .keras)",
+        SparkDLTypeConverters.toExistingFilePath,
+    )
+
+    def __init__(self, inputCol=None, outputCol=None, modelFile=None,
+                 imageLoader=None, batchSize=None):
+        super().__init__()
+        self._setDefault(batchSize=32)
+        self._set(inputCol=inputCol, outputCol=outputCol, modelFile=modelFile,
+                  imageLoader=imageLoader, batchSize=batchSize)
+
+    def setModelFile(self, value: str):
+        return self._set(modelFile=value)
+
+    def getModelFile(self) -> str:
+        return self.getOrDefault("modelFile")
+
+    def _transform(self, dataset):
+        import os
+
+        model_file = self.getModelFile()
+        mtime = os.path.getmtime(model_file)
+        batch_size = self.getBatchSize()
+        input_col = self.getInputCol()
+        output_col = self.getOutputCol()
+        loader = self.getImageLoader()
+        if loader is None:
+            raise ValueError("imageLoader is not set")
+
+        def partition_fn(rows):
+            rows = list(rows)
+            if not rows:
+                return iter(())
+            runner = _keras_runner(model_file, mtime, batch_size)
+
+            def extract(row):
+                arr = np.asarray(loader(row[input_col]), dtype=np.float32)
+                # loaders may emit a leading batch dim of 1; strip it
+                if arr.ndim == 4 and arr.shape[0] == 1:
+                    arr = arr[0]
+                return {"x": arr}
+
+            return run_partition_with_passthrough(
+                rows, extract, runner, output_col,
+                lambda o: np.asarray(o, dtype=np.float32).reshape(-1),
+                input_cols=(input_col,),
+            )
+
+        return transform_partitions(
+            dataset, partition_fn, [(output_col, "array<float>")]
+        )

@@ -1,0 +1,4 @@
+from sparkdl_tpu.udf.keras_image_model import registerKerasImageUDF
+from sparkdl_tpu.udf.registry import getUDF, listUDFs, registerUDF
+
+__all__ = ["registerKerasImageUDF", "registerUDF", "getUDF", "listUDFs"]

@@ -1,0 +1,83 @@
+"""registerKerasImageUDF — deploy a Keras image model as a SQL-style UDF.
+
+Reference parity (SURVEY.md 2.14/3.5, [U: python/sparkdl/udf/
+keras_image_model.py]): compose (image-struct converter ⊕ optional user
+preprocessor ⊕ model) into one function and register it under a name, so
+``SELECT my_udf(image) FROM t`` scores images. The reference splices three
+TF graph pieces into one GraphFunction and registers it JVM-side; here the
+composition is a host decode (image struct → RGB array) feeding a single
+jitted JAX call (resize → preprocessor → model), registered in the
+framework registry (and with Spark SQL when a session is live).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import numpy as np
+
+from sparkdl_tpu.udf.registry import registerUDF
+
+
+def registerKerasImageUDF(
+    udf_name: str,
+    keras_model_or_file,
+    preprocessor: "Callable | None" = None,
+    spark_session=None,
+) -> Callable:
+    """Register ``udf_name`` scoring image structs with a Keras model.
+
+    ``keras_model_or_file``: a keras.Model or path to .h5/.keras.
+    ``preprocessor``: optional jax-traceable fn batch_f32_rgb -> model input
+    (runs on device, fused into the model's XLA program). Returns the
+    registered callable (image struct / ndarray -> np.ndarray of floats).
+    """
+    import keras
+
+    if isinstance(keras_model_or_file, str):
+        model = keras.models.load_model(keras_model_or_file, compile=False)
+    else:
+        model = keras_model_or_file
+
+    in_shape = model.input_shape
+    if isinstance(in_shape, list):
+        raise ValueError("registerKerasImageUDF requires a single-input model")
+    target_hw = None
+    if len(in_shape) == 4 and in_shape[1] is not None and in_shape[2] is not None:
+        target_hw = (int(in_shape[1]), int(in_shape[2]))
+
+    if keras.backend.backend() == "jax":
+        import jax
+
+        trainable = [v.value for v in model.trainable_variables]
+        non_trainable = [v.value for v in model.non_trainable_variables]
+
+        @jax.jit
+        def _apply(batch):
+            x = batch
+            if preprocessor is not None:
+                x = preprocessor(x)
+            y, _ = model.stateless_call(trainable, non_trainable, x, training=False)
+            return y
+    else:  # pragma: no cover - non-jax Keras backend
+        def _apply(batch):
+            x = preprocessor(batch) if preprocessor is not None else batch
+            return model(x, training=False)
+
+    @functools.wraps(_apply)
+    def udf(image) -> np.ndarray:
+        from sparkdl_tpu.transformers.named_image import (
+            _image_to_rgb_array,
+            _resize_host,
+        )
+
+        arr = _image_to_rgb_array(image)
+        if target_hw is not None:
+            arr = _resize_host(arr, target_hw)
+        out = np.asarray(_apply(np.asarray(arr, np.float32)[None]))
+        return out[0]
+
+    udf.__name__ = udf_name
+    registerUDF(udf_name, udf, spark_session=spark_session)
+    return udf

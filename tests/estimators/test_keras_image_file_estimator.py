@@ -1,0 +1,91 @@
+"""KerasImageFileEstimator tests (SURVEY.md §4, [U: python/tests/estimators/
+keras_image_file_estimator_test.py]): fit over URIs + labels, per-paramMap
+models, outputs usable as transformers."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from sparkdl_tpu import KerasImageFileEstimator
+from sparkdl_tpu.dataframe.local import LocalDataFrame
+from sparkdl_tpu.transformers.keras_image import KerasImageFileTransformer
+
+SIZE = 6
+N_CLASSES = 3
+
+
+@pytest.fixture(scope="module")
+def base_model_file(tmp_path_factory):
+    import keras
+
+    model = keras.Sequential(
+        [
+            keras.layers.Input((SIZE, SIZE, 3)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(N_CLASSES, activation="softmax"),
+        ]
+    )
+    path = str(tmp_path_factory.mktemp("est") / "base.keras")
+    model.save(path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def labeled_df(tmp_path_factory):
+    d = tmp_path_factory.mktemp("est_uris")
+    rng = np.random.default_rng(1)
+    rows = []
+    for i in range(9):
+        p = d / f"x{i}.png"
+        Image.fromarray(
+            rng.integers(0, 256, (SIZE, SIZE, 3), dtype=np.uint8)
+        ).save(p)
+        onehot = np.zeros(N_CLASSES, np.float32)
+        onehot[i % N_CLASSES] = 1.0
+        rows.append({"uri": str(p), "label": onehot})
+    return LocalDataFrame.from_rows(rows, num_partitions=2)
+
+
+def _loader(uri: str) -> np.ndarray:
+    return np.asarray(Image.open(uri).convert("RGB"), dtype=np.float32) / 255.0
+
+
+def _estimator(model_file):
+    return KerasImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        modelFile=model_file, imageLoader=_loader,
+        kerasLoss="categorical_crossentropy", kerasOptimizer="adam",
+        kerasFitParams={"epochs": 2, "verbose": 0}, batchSize=4,
+    )
+
+
+def test_fit_returns_usable_transformer(base_model_file, labeled_df):
+    model = _estimator(base_model_file).fit(labeled_df)
+    assert isinstance(model, KerasImageFileTransformer)
+    out = model.transform(labeled_df).collect()
+    assert all(len(r["preds"]) == N_CLASSES for r in out)
+    probs = np.stack([r["preds"] for r in out])
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_fit_multiple_param_maps(base_model_file, labeled_df):
+    est = _estimator(base_model_file)
+    pm = [
+        {"kerasFitParams": {"epochs": 1, "verbose": 0}},
+        {"kerasFitParams": {"epochs": 3, "verbose": 0}},
+    ]
+    models = est.fit(labeled_df, pm)
+    assert len(models) == 2
+    f0 = models[0].getOrDefault("modelFile")
+    f1 = models[1].getOrDefault("modelFile")
+    assert f0 != f1  # independently tuned/saved models
+
+
+def test_fit_without_labels_rejected(base_model_file, labeled_df):
+    est = KerasImageFileEstimator(
+        inputCol="uri", outputCol="preds",
+        modelFile=base_model_file, imageLoader=_loader,
+        kerasLoss="categorical_crossentropy",
+    )
+    with pytest.raises((ValueError, KeyError)):
+        est.fit(labeled_df.select("uri"))

@@ -1,0 +1,83 @@
+"""KerasImageFileTransformer tests (SURVEY.md §4, [U: python/tests/
+transformers/keras_image_test.py]): URI column + user imageLoader, oracle =
+direct keras predict on the same loaded batch."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from sparkdl_tpu import KerasImageFileTransformer
+from sparkdl_tpu.dataframe.local import LocalDataFrame
+
+SIZE = 8
+
+
+@pytest.fixture(scope="module")
+def cnn_file(tmp_path_factory):
+    import keras
+
+    model = keras.Sequential(
+        [
+            keras.layers.Input((SIZE, SIZE, 3)),
+            keras.layers.Conv2D(4, 3, activation="relu"),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(5, activation="softmax"),
+        ]
+    )
+    path = str(tmp_path_factory.mktemp("keras") / "cnn.keras")
+    model.save(path)
+    return path, model
+
+
+@pytest.fixture(scope="module")
+def image_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("uris")
+    rng = np.random.default_rng(9)
+    paths = []
+    for i in range(7):
+        p = d / f"img{i}.png"
+        Image.fromarray(
+            rng.integers(0, 256, (SIZE * 2, SIZE * 2, 3), dtype=np.uint8)
+        ).save(p)
+        paths.append(str(p))
+    return paths
+
+
+def _loader(uri: str) -> np.ndarray:
+    img = Image.open(uri).convert("RGB").resize((SIZE, SIZE), Image.BILINEAR)
+    return np.asarray(img, dtype=np.float32) / 255.0
+
+
+def test_matches_direct_keras(cnn_file, image_files):
+    path, model = cnn_file
+    df = LocalDataFrame.from_rows(
+        [{"uri": u} for u in image_files], num_partitions=2
+    )
+    out = KerasImageFileTransformer(
+        inputCol="uri", outputCol="preds", modelFile=path,
+        imageLoader=_loader, batchSize=3,
+    ).transform(df).collect()
+    batch = np.stack([_loader(u) for u in image_files])
+    oracle = np.asarray(model.predict(batch, verbose=0))
+    got = np.stack([r["preds"] for r in out])
+    np.testing.assert_allclose(got, oracle, rtol=1e-4, atol=1e-5)
+
+
+def test_unreadable_uri_yields_none(cnn_file, image_files):
+    path, _ = cnn_file
+    rows = [{"uri": image_files[0]}, {"uri": "/nope/missing.png"}]
+    out = KerasImageFileTransformer(
+        inputCol="uri", outputCol="preds", modelFile=path, imageLoader=_loader
+    ).transform(LocalDataFrame.from_rows(rows)).collect()
+    assert out[0]["preds"] is not None
+    assert out[1]["preds"] is None
+
+
+def test_loader_with_batch_dim(cnn_file, image_files):
+    path, model = cnn_file
+    out = KerasImageFileTransformer(
+        inputCol="uri", outputCol="preds", modelFile=path,
+        imageLoader=lambda u: _loader(u)[None],  # keras-style (1, H, W, C)
+    ).transform(LocalDataFrame.from_rows([{"uri": image_files[0]}])).collect()
+    oracle = model.predict(_loader(image_files[0])[None], verbose=0)[0]
+    np.testing.assert_allclose(out[0]["preds"], oracle, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,78 @@
+"""registerKerasImageUDF tests (SURVEY.md §4, [U: python/tests/udf/
+keras_image_model_test.py]): registry round-trip, oracle vs direct predict,
+preprocessor composition."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu import registerKerasImageUDF
+from sparkdl_tpu.dataframe.local import LocalDataFrame
+from sparkdl_tpu.image.imageIO import imageArrayToStructBGR
+from sparkdl_tpu.udf.registry import applyUDF, getUDF, listUDFs
+
+SIZE = 8
+
+
+@pytest.fixture(scope="module")
+def model():
+    import keras
+
+    return keras.Sequential(
+        [
+            keras.layers.Input((SIZE, SIZE, 3)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(4, activation="softmax"),
+        ]
+    )
+
+
+@pytest.fixture(scope="module")
+def image_rows():
+    rng = np.random.default_rng(2)
+    return [
+        {"image": imageArrayToStructBGR(
+            rng.integers(0, 256, (SIZE, SIZE, 3), dtype=np.uint8)
+        )}
+        for _ in range(4)
+    ]
+
+
+def test_register_and_apply(model, image_rows):
+    registerKerasImageUDF("score_img", model)
+    assert "score_img" in listUDFs()
+    df = LocalDataFrame.from_rows(image_rows, num_partitions=2)
+    out = applyUDF("score_img", df, "image", "probs").collect()
+
+    from sparkdl_tpu.image.imageIO import imageStructToArray
+
+    batch = np.stack(
+        [imageStructToArray(r["image"])[..., ::-1] for r in image_rows]
+    ).astype(np.float32)
+    oracle = np.asarray(model.predict(batch, verbose=0))
+    got = np.stack([r["probs"] for r in out])
+    np.testing.assert_allclose(got, oracle, rtol=1e-4, atol=1e-5)
+
+
+def test_preprocessor_composes(model, image_rows):
+    registerKerasImageUDF("score_scaled", model, preprocessor=lambda x: x / 255.0)
+    udf = getUDF("score_scaled")
+    got = udf(image_rows[0]["image"])
+
+    from sparkdl_tpu.image.imageIO import imageStructToArray
+
+    arr = imageStructToArray(image_rows[0]["image"])[..., ::-1].astype(np.float32)
+    oracle = model.predict((arr / 255.0)[None], verbose=0)[0]
+    np.testing.assert_allclose(got, oracle, rtol=1e-4, atol=1e-5)
+
+
+def test_model_from_file(model, tmp_path, image_rows):
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+    registerKerasImageUDF("score_from_file", path)
+    got = getUDF("score_from_file")(image_rows[0]["image"])
+    assert got.shape == (4,)
+
+
+def test_unknown_udf_rejected():
+    with pytest.raises(KeyError, match="no UDF named"):
+        getUDF("definitely_not_registered")
