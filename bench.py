@@ -23,6 +23,11 @@ import numpy as np
 
 def main() -> None:
     import jax
+
+    # The image's sitecustomize may pre-select the TPU platform at interpreter
+    # start; honor an explicit JAX_PLATFORMS so CPU smoke runs stay on CPU.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
 
     from sparkdl_tpu.models.registry import build_flax_model, get_entry

@@ -1,0 +1,3 @@
+from sparkdl_tpu.runner.tpu_runner import HorovodRunner, TPURunner
+
+__all__ = ["TPURunner", "HorovodRunner"]

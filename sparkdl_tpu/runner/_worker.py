@@ -1,0 +1,60 @@
+"""Worker-process entry point for TPURunner's local-process backend.
+
+Launched as ``python -m sparkdl_tpu.runner._worker <payload> <rank> <np>
+<coordinator> <result_path>``. The payload (cloudpickle) carries the user fn,
+kwargs, and env overrides. Env/JAX setup must happen before jax initializes a
+backend, which is why this is a fresh process, not a fork.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import traceback
+
+
+def main(argv: list[str]) -> int:
+    payload_path, rank_s, np_s, coordinator, result_path = argv
+    rank, nprocs = int(rank_s), int(np_s)
+
+    import cloudpickle
+
+    with open(payload_path, "rb") as f:
+        payload = cloudpickle.load(f)
+
+    for k, v in payload["env"].items():
+        os.environ[k] = v
+
+    import jax
+
+    # sitecustomize may have imported jax already with another platform
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=nprocs,
+        process_id=rank,
+    )
+
+    fn = payload["fn"]
+    kwargs = payload["kwargs"]
+    try:
+        result = fn(**kwargs)
+    except Exception:
+        traceback.print_exc()
+        return 1
+
+    if rank == 0:
+        with open(result_path, "wb") as f:
+            try:
+                pickle.dump(("ok", result), f)
+            except Exception as e:  # unpicklable user return value
+                f.seek(0)
+                pickle.dump(("unpicklable", repr(e)), f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,218 @@
+"""Cluster backends for TPURunner: local processes and Spark barrier jobs.
+
+Reference parity (SURVEY.md 2.13/3.4): HorovodRunner's two regimes —
+``np < 0`` local debug processes, ``np > 0`` Spark barrier tasks with an
+MPI rendezvous — map here to :class:`LocalProcessBackend` (subprocesses on
+this host) and :class:`SparkBarrierBackend` (one barrier task per TPU host,
+rendezvous via ``BarrierTaskContext.allGather``). Both end in
+``jax.distributed.initialize``: in-step gradient comm is XLA collectives
+over ICI/DCN compiled into the program, so there is no user-space ring to
+bootstrap — only the coordinator address exchange.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class LocalProcessBackend:
+    """Run n ranks as subprocesses of this host (HorovodRunner np<0 mode).
+
+    Each rank is a fresh interpreter (env must precede jax import). By
+    default ranks run on CPU with ``devices_per_process`` fake devices each,
+    so multi-process collective code is debuggable on one machine with (or
+    without) a single TPU chip.
+    """
+
+    def __init__(self, devices_per_process: int = 1, platform: "str | None" = "cpu",
+                 timeout_s: float = 600.0):
+        self.devices_per_process = devices_per_process
+        self.platform = platform
+        self.timeout_s = timeout_s
+
+    def run(self, nprocs: int, fn: Callable, kwargs: dict,
+            verbosity: str = "all") -> Any:
+        import cloudpickle
+
+        env_overrides = {}
+        if self.platform:
+            env_overrides["JAX_PLATFORMS"] = self.platform
+        if self.platform == "cpu" and self.devices_per_process > 1:
+            env_overrides["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={self.devices_per_process}"
+            ).strip()
+
+        workdir = tempfile.mkdtemp(prefix="sparkdl_tpu_run_")
+        payload_path = os.path.join(workdir, "payload.pkl")
+        result_path = os.path.join(workdir, "result.pkl")
+        with open(payload_path, "wb") as f:
+            cloudpickle.dump(
+                {"fn": fn, "kwargs": kwargs, "env": env_overrides}, f
+            )
+
+        coordinator = f"localhost:{free_port()}"
+        # children must resolve the same modules as the parent (the user fn
+        # may be pickled by reference to a module only on the parent's path)
+        child_env = os.environ.copy()
+        child_env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p] + [child_env.get("PYTHONPATH", "")]
+        ).rstrip(os.pathsep)
+        procs: list[subprocess.Popen] = []
+        streams: list[threading.Thread] = []
+        try:
+            for rank in range(nprocs):
+                p = subprocess.Popen(
+                    [
+                        sys.executable, "-m", "sparkdl_tpu.runner._worker",
+                        payload_path, str(rank), str(nprocs), coordinator,
+                        result_path,
+                    ],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                    env=child_env,
+                )
+                procs.append(p)
+                t = threading.Thread(
+                    target=_stream_output, args=(p, rank, verbosity), daemon=True
+                )
+                t.start()
+                streams.append(t)
+
+            failed = _wait_all(procs, self.timeout_s)
+            for t in streams:
+                t.join(timeout=5)
+            if failed:
+                ranks = ", ".join(str(r) for r in failed)
+                raise RuntimeError(
+                    f"TPURunner local job failed on rank(s) {ranks} "
+                    f"(barrier semantics: whole job aborted)"
+                )
+            return _load_result(result_path)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+
+
+def _stream_output(proc: subprocess.Popen, rank: int, verbosity: str) -> None:
+    assert proc.stdout is not None
+    for line in proc.stdout:
+        if verbosity == "all":
+            print(f"[rank {rank}] {line}", end="", flush=True)
+        else:
+            logger.debug("[rank %d] %s", rank, line.rstrip())
+
+
+def _wait_all(procs: list[subprocess.Popen], timeout_s: float) -> list[int]:
+    """Wait for every rank; on first failure or timeout kill the rest.
+
+    Returns the list of failed ranks (empty on success).
+    """
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    pending = dict(enumerate(procs))
+    failed: list[int] = []
+    while pending and not failed:
+        for rank, p in list(pending.items()):
+            rc = p.poll()
+            if rc is None:
+                continue
+            del pending[rank]
+            if rc != 0:
+                failed.append(rank)
+        if time.monotonic() > deadline:
+            failed.extend(pending.keys())
+            break
+        time.sleep(0.05)
+    for p in pending.values():
+        p.kill()
+    return sorted(failed)
+
+
+def _load_result(result_path: str) -> Any:
+    if not os.path.exists(result_path):
+        raise RuntimeError("rank 0 produced no result file")
+    with open(result_path, "rb") as f:
+        status, value = pickle.load(f)
+    if status == "unpicklable":
+        raise RuntimeError(
+            f"rank 0's return value could not be pickled: {value}"
+        )
+    return value
+
+
+class SparkBarrierBackend:
+    """np>0 mode: one barrier task per TPU host via a live SparkSession.
+
+    The task body rendezvouses through ``BarrierTaskContext.allGather``
+    (rank 0 publishes ``host:port``), calls ``jax.distributed.initialize``
+    with that coordinator, runs the user fn, and returns rank 0's result to
+    the driver — the reference's mpirun bootstrap replaced by coordinator
+    address exchange (SURVEY.md §5 "Distributed communication backend").
+    """
+
+    def __init__(self, spark_session=None):
+        if spark_session is None:
+            from pyspark.sql import SparkSession
+
+            spark_session = SparkSession.getActiveSession()
+        if spark_session is None:
+            raise RuntimeError(
+                "no active SparkSession; np>0 needs a cluster (or use np<0 "
+                "local mode)"
+            )
+        self.spark = spark_session
+
+    def run(self, nprocs: int, fn: Callable, kwargs: dict,
+            verbosity: str = "all") -> Any:
+        import cloudpickle
+
+        payload = cloudpickle.dumps({"fn": fn, "kwargs": kwargs})
+        sc = self.spark.sparkContext
+
+        def barrier_task(it):
+            from pyspark import BarrierTaskContext
+
+            ctx = BarrierTaskContext.get()
+            rank = ctx.partitionId()
+            port = free_port()
+            addrs = ctx.allGather(f"{socket.gethostname()}:{port}")
+            coordinator = addrs[0]
+
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=nprocs,
+                process_id=rank,
+            )
+            p = cloudpickle.loads(payload)
+            out = p["fn"](**p["kwargs"])
+            yield pickle.dumps(out) if rank == 0 else b""
+
+        results = (
+            sc.parallelize(range(nprocs), nprocs)
+            .barrier()
+            .mapPartitions(barrier_task)
+            .collect()
+        )
+        return pickle.loads(results[0])

@@ -1,0 +1,65 @@
+"""TPURunner local-mode tests (SURVEY.md §4: HorovodRunner's np<0 local mode
+is the multi-node-without-a-cluster story; here it really launches processes
+and initializes the global JAX runtime across them)."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu import HorovodRunner, TPURunner
+
+
+def _train_fn(scale=1.0):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    assert jax.process_count() == 2
+    x = jnp.ones(3) * (jax.process_index() + 1) * scale
+    gathered = multihost_utils.process_allgather(x)
+    return {
+        "rank": jax.process_index(),
+        "nprocs": jax.process_count(),
+        "global_devices": jax.device_count(),
+        "sum": float(gathered.sum()),
+    }
+
+
+@pytest.mark.slow
+def test_local_mode_two_processes():
+    hr = TPURunner(np=-2, devices_per_process=2)
+    out = hr.run(_train_fn, scale=2.0)
+    assert out["rank"] == 0  # rank 0's result comes back
+    assert out["nprocs"] == 2
+    assert out["global_devices"] == 4  # 2 procs x 2 fake devices
+    # allgather saw both ranks: (1+2) * 3 elements * scale 2
+    assert out["sum"] == pytest.approx(18.0)
+
+
+@pytest.mark.slow
+def test_failure_aborts_job():
+    def boom():
+        import jax  # noqa: F401  (join the job before dying)
+
+        raise RuntimeError("worker exploded")
+
+    with pytest.raises(RuntimeError, match="rank"):
+        TPURunner(np=-2, timeout_s=120).run(boom)
+
+
+def test_horovod_runner_alias():
+    assert HorovodRunner is TPURunner
+
+
+def test_np_zero_rejected():
+    with pytest.raises(ValueError):
+        TPURunner(np=0)
+
+
+def test_positive_np_without_cluster():
+    with pytest.raises(RuntimeError, match="cluster"):
+        TPURunner(np=4).run(lambda: None)
+
+
+def test_bad_verbosity_rejected():
+    with pytest.raises(ValueError):
+        TPURunner(np=-1, driver_log_verbosity="loud")
