@@ -1,0 +1,47 @@
+"""Parallelism strategies over the named device mesh.
+
+The reference's only parallelism is data parallelism (SURVEY.md 2.11): one
+TF session per Spark executor for inference, a Horovod NCCL ring for
+training (2.13/2.16/2.17). On TPU those collectives are not a user-space
+library but XLA programs over ICI — this package owns the idiomatic forms:
+
+- :mod:`collectives` — shard_map-level collective helpers (grad psum,
+  reduce-scatter/all-gather param sync) replacing Horovod's fused
+  ring-allreduce engine.
+- :mod:`ring_attention` — sequence/context parallelism: blockwise attention
+  with K/V blocks rotating around the ``sp`` ring via ``ppermute``
+  (long-context support the reference never had).
+- :mod:`tensor_parallel` — column/row-parallel Dense + TP attention/MLP
+  layers with the ``psum`` placed exactly once per block.
+- :mod:`pipeline` — collective-permute pipeline parallelism over the ``pp``
+  axis (GPipe schedule via ``lax.scan``).
+
+Axis names are the canonical ones from ``sparkdl_tpu.runtime.mesh``.
+"""
+
+from sparkdl_tpu.parallel.collectives import (
+    all_gather_params,
+    cross_replica_mean,
+    psum_grads,
+    reduce_scatter_grads,
+)
+from sparkdl_tpu.parallel.ring_attention import ring_attention, ring_self_attention
+from sparkdl_tpu.parallel.tensor_parallel import (
+    ColumnParallelDense,
+    RowParallelDense,
+    TPMlpBlock,
+)
+from sparkdl_tpu.parallel.pipeline import pipeline_apply
+
+__all__ = [
+    "all_gather_params",
+    "cross_replica_mean",
+    "psum_grads",
+    "reduce_scatter_grads",
+    "ring_attention",
+    "ring_self_attention",
+    "ColumnParallelDense",
+    "RowParallelDense",
+    "TPMlpBlock",
+    "pipeline_apply",
+]

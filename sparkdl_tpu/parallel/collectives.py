@@ -1,0 +1,88 @@
+"""Collective helpers used inside shard_map'd train steps.
+
+Horovod's C++ engine (SURVEY.md 2.16) exists to fuse gradient tensors and
+drive a NCCL ring; under XLA the fusion and scheduling belong to the
+compiler, so the framework-level deliverable is just the right collective
+in the right place. These helpers are the vocabulary the train steps use.
+
+All functions take pytrees and an axis name (or tuple of names) and are
+meant to be called *inside* ``jax.shard_map`` / under a mesh context —
+outside one, jax raises an unbound-axis error, which is the correct
+failure mode.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisNames = str | Sequence[str]
+
+
+def cross_replica_mean(tree: Any, axis: AxisNames = "dp") -> Any:
+    """Mean-allreduce a pytree over the data axes.
+
+    The Horovod-parity op: ``hvd.DistributedOptimizer`` averages gradients
+    across the ring; here it is one ``psum`` divided by the axis size,
+    compiled onto ICI.
+    """
+    n = lax.psum(1, axis)
+    return jax.tree.map(lambda g: lax.psum(g, axis) / n, tree)
+
+
+def psum_grads(grads: Any, axis: AxisNames = "dp") -> Any:
+    """Sum-allreduce gradients (caller owns any scaling)."""
+    return jax.tree.map(lambda g: lax.psum(g, axis), grads)
+
+
+def reduce_scatter_grads(grads: Any, axis: str = "fsdp") -> Any:
+    """Reduce-scatter gradients over ``axis`` along each leaf's dim 0.
+
+    The ZeRO/FSDP half of the ring-allreduce: each device keeps only its
+    shard of the summed gradient. Leaves whose dim 0 is not divisible by
+    the axis size are fully reduced instead (scalars, small biases).
+    """
+    n = lax.psum(1, axis)
+
+    def _rs(g: jax.Array) -> jax.Array:
+        if g.ndim == 0 or g.shape[0] % n != 0:
+            return lax.psum(g, axis)
+        return lax.psum_scatter(g, axis, scatter_dimension=0, tiled=True)
+
+    return jax.tree.map(_rs, grads)
+
+
+def all_gather_params(params: Any, axis: str = "fsdp", *, full_shapes: Any = None) -> Any:
+    """All-gather FSDP-sharded params along dim 0 for use in the forward.
+
+    ``full_shapes`` — a matching pytree of the *unsharded* leaf shapes
+    (e.g. from ``jax.eval_shape`` of the init) — tells us which leaves
+    :func:`reduce_scatter_grads` actually scattered: those whose dim 0 was
+    divisible by the axis size. Leaves it left whole (scalars, small
+    biases) are returned as-is instead of being gathered into n stacked
+    copies. Without ``full_shapes``, every ndim>0 leaf is assumed sharded.
+    """
+    n = lax.psum(1, axis)
+
+    def _ag(p: jax.Array, full=None) -> jax.Array:
+        if p.ndim == 0:
+            return p
+        if full is not None and (len(full.shape) == 0 or full.shape[0] % n != 0):
+            return p  # was never scattered
+        return lax.all_gather(p, axis, axis=0, tiled=True)
+
+    if full_shapes is None:
+        return jax.tree.map(_ag, params)
+    return jax.tree.map(_ag, params, full_shapes)
+
+
+def global_norm(tree: Any, axis: AxisNames | None = None) -> jax.Array:
+    """L2 norm of a pytree; if ``axis`` given, the *global* norm of a tree
+    whose leaves are sharded over that axis (sums squares with psum)."""
+    sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in jax.tree.leaves(tree))
+    if axis is not None:
+        sq = lax.psum(sq, axis)
+    return jnp.sqrt(sq)

@@ -23,9 +23,8 @@ def main(argv: list[str]) -> int:
     with open(payload_path, "rb") as f:
         payload = cloudpickle.load(f)
 
-    for k, v in payload["env"].items():
-        os.environ[k] = v
-
+    # Env overrides (JAX_PLATFORMS, XLA_FLAGS, ...) arrive via the process
+    # environment, set by the parent before exec — nothing to apply here.
     import jax
 
     # sitecustomize may have imported jax already with another platform

@@ -15,6 +15,7 @@ from __future__ import annotations
 import logging
 import os
 import pickle
+import shutil
 import socket
 import subprocess
 import sys
@@ -63,9 +64,7 @@ class LocalProcessBackend:
         payload_path = os.path.join(workdir, "payload.pkl")
         result_path = os.path.join(workdir, "result.pkl")
         with open(payload_path, "wb") as f:
-            cloudpickle.dump(
-                {"fn": fn, "kwargs": kwargs, "env": env_overrides}, f
-            )
+            cloudpickle.dump({"fn": fn, "kwargs": kwargs}, f)
 
         coordinator = f"localhost:{free_port()}"
         # children must resolve the same modules as the parent (the user fn
@@ -74,6 +73,10 @@ class LocalProcessBackend:
         child_env["PYTHONPATH"] = os.pathsep.join(
             [p for p in sys.path if p] + [child_env.get("PYTHONPATH", "")]
         ).rstrip(os.pathsep)
+        # Env overrides ride the process env, not the payload: they must be
+        # in place before the child interpreter starts (sitecustomize may
+        # import jax at startup, long before the worker unpickles anything).
+        child_env.update(env_overrides)
         procs: list[subprocess.Popen] = []
         streams: list[threading.Thread] = []
         try:
@@ -110,6 +113,7 @@ class LocalProcessBackend:
             for p in procs:
                 if p.poll() is None:
                     p.kill()
+            shutil.rmtree(workdir, ignore_errors=True)
 
 
 def _stream_output(proc: subprocess.Popen, rank: int, verbosity: str) -> None:
