@@ -1,0 +1,163 @@
+"""Hyperopt-style distributed hyperparameter search.
+
+Reference parity: Databricks pairs HorovodRunner with Hyperopt's
+``fmin``/``SparkTrials`` for distributed HPO (SURVEY.md 2.13; BASELINE.md
+configs[4] "BERT-base fine-tune + Hyperopt distributed HPO"). Hyperopt
+itself is an optional dependency: when installed, :func:`fmin` delegates to
+it; otherwise a built-in random-search engine with the same call shape
+runs, so the API works in hermetic environments.
+
+Trials execute through a pluggable ``trial_runner`` — sequential by
+default, or fan trials out however you like (each trial's objective may
+itself call :class:`~sparkdl_tpu.runner.TPURunner` for multi-host
+training, which is exactly the reference's Hyperopt+HorovodRunner nesting).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+try:  # optional, API-compatible fast path
+    import hyperopt as _hyperopt
+except Exception:  # pragma: no cover - not in the hermetic image
+    _hyperopt = None
+
+
+# --------------------------------------------------------------------------
+# Search-space primitives (hyperopt.hp-compatible subset)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class _Dist:
+    kind: str
+    label: str
+    args: tuple
+
+    def sample(self, rng: np.random.Generator) -> Any:
+        if self.kind == "uniform":
+            lo, hi = self.args
+            return float(rng.uniform(lo, hi))
+        if self.kind == "loguniform":
+            lo, hi = self.args  # log-space bounds, as in hyperopt
+            return float(np.exp(rng.uniform(lo, hi)))
+        if self.kind == "quniform":
+            lo, hi, q = self.args
+            return float(np.round(rng.uniform(lo, hi) / q) * q)
+        if self.kind == "choice":
+            (options,) = self.args
+            return options[int(rng.integers(len(options)))]
+        raise ValueError(f"unknown dist {self.kind}")
+
+
+class hp:
+    """Drop-in subset of ``hyperopt.hp``."""
+
+    @staticmethod
+    def uniform(label: str, low: float, high: float) -> _Dist:
+        return _Dist("uniform", label, (low, high))
+
+    @staticmethod
+    def loguniform(label: str, low: float, high: float) -> _Dist:
+        return _Dist("loguniform", label, (low, high))
+
+    @staticmethod
+    def quniform(label: str, low: float, high: float, q: float) -> _Dist:
+        return _Dist("quniform", label, (low, high, q))
+
+    @staticmethod
+    def choice(label: str, options: Sequence[Any]) -> _Dist:
+        return _Dist("choice", label, (tuple(options),))
+
+
+def sample_space(space: dict, rng: np.random.Generator) -> dict:
+    return {
+        k: v.sample(rng) if isinstance(v, _Dist) else v
+        for k, v in space.items()
+    }
+
+
+@dataclasses.dataclass
+class Trials:
+    """Result log (hyperopt.Trials-shaped: .trials, .best_trial)."""
+
+    trials: list[dict] = dataclasses.field(default_factory=list)
+
+    @property
+    def best_trial(self) -> dict:
+        ok = [t for t in self.trials if t["status"] == "ok"]
+        if not ok:
+            raise RuntimeError("no successful trials")
+        return min(ok, key=lambda t: t["loss"])
+
+    @property
+    def losses(self) -> list[float | None]:
+        return [t.get("loss") for t in self.trials]
+
+
+def fmin(
+    objective: Callable[[dict], float | dict],
+    space: dict,
+    *,
+    max_evals: int = 20,
+    seed: int = 0,
+    parallelism: int = 1,
+    trials: Trials | None = None,
+    use_hyperopt: bool | None = None,
+) -> dict:
+    """Minimise ``objective`` over ``space``; returns the best param dict.
+
+    ``objective`` gets a concrete param dict and returns a float loss (or a
+    dict with a ``loss`` key, hyperopt-style). With hyperopt installed (and
+    ``use_hyperopt`` not False) delegates to ``hyperopt.fmin`` + TPE;
+    otherwise runs seeded random search, ``parallelism`` trials at a time
+    (threads — each trial typically blocks on device work or a TPURunner
+    job, so the GIL is not the limiter).
+    """
+    if use_hyperopt is None:
+        use_hyperopt = _hyperopt is not None
+    if use_hyperopt:
+        if _hyperopt is None:
+            raise RuntimeError("hyperopt requested but not installed")
+        hp_space = {
+            k: getattr(_hyperopt.hp, v.kind)(v.label, *(
+                (v.args[0],) if v.kind == "choice" else v.args
+            ))
+            for k, v in space.items()
+        }
+        return _hyperopt.fmin(
+            objective, hp_space, algo=_hyperopt.tpe.suggest,
+            max_evals=max_evals, rstate=np.random.default_rng(seed),
+        )
+
+    trials = trials if trials is not None else Trials()
+    rng = np.random.default_rng(seed)
+    candidates = [sample_space(space, rng) for _ in range(max_evals)]
+
+    def run_one(i_params):
+        i, params = i_params
+        try:
+            out = objective(params)
+            loss = out["loss"] if isinstance(out, dict) else float(out)
+            extra = out if isinstance(out, dict) else {}
+            return {"tid": i, "params": params, "loss": float(loss),
+                    "status": "ok", **{k: v for k, v in extra.items()
+                                       if k not in ("loss", "status")}}
+        except Exception as e:  # a failed trial shouldn't kill the sweep
+            logger.warning("trial %d failed: %s", i, e)
+            return {"tid": i, "params": params, "loss": None, "status": "fail",
+                    "error": repr(e)}
+
+    if parallelism <= 1:
+        results = [run_one(x) for x in enumerate(candidates)]
+    else:
+        with ThreadPoolExecutor(max_workers=parallelism) as pool:
+            results = list(pool.map(run_one, enumerate(candidates)))
+    trials.trials.extend(results)
+    return dict(trials.best_trial["params"])

@@ -6,6 +6,13 @@ from sparkdl_tpu.models.registry import (
     get_entry,
     registry,
 )
+from sparkdl_tpu.models.bert import (
+    BertConfig,
+    BertForSequenceClassification,
+    BertModel,
+    config_from_hf,
+    load_hf_bert,
+)
 
 __all__ = [
     "SUPPORTED_MODELS",
@@ -14,4 +21,9 @@ __all__ = [
     "build_keras_model",
     "get_entry",
     "registry",
+    "BertConfig",
+    "BertForSequenceClassification",
+    "BertModel",
+    "config_from_hf",
+    "load_hf_bert",
 ]

@@ -29,12 +29,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/where() NaN-free
 
 
-def _block_attend(q, k, v, o, m, l, *, q_offset, k_offset, causal, scale):
+def _block_attend(q, k, v, o, m, l, *, q_offset, k_offset, causal, scale,
+                  kv_mask=None):
     """Fold one visiting K/V block into the running (o, m, l) accumulators.
 
     q: [B, Lq, H, D]   k, v: [B, Lk, H, D]
     o: [B, Lq, H, D] f32 accumulator (un-normalised)
     m: [B, H, Lq] f32 running max,  l: [B, H, Lq] f32 running denominator
+    kv_mask: optional [B, Lk] bool — False keys are masked out (padding).
     """
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -45,6 +47,8 @@ def _block_attend(q, k, v, o, m, l, *, q_offset, k_offset, causal, scale):
         k_pos = k_offset + jnp.arange(lk)
         mask = q_pos[:, None] >= k_pos[None, :]
         s = jnp.where(mask[None, None], s, _NEG_INF)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, _NEG_INF)
 
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     correction = jnp.exp(m - m_new)  # [B, H, Lq]
@@ -62,12 +66,17 @@ def _ring_attention_local(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    kv_mask: jax.Array | None = None,
     *,
     axis_name: str,
     causal: bool,
     scale: float | None,
 ) -> jax.Array:
-    """Per-device body; call inside shard_map with q/k/v local blocks."""
+    """Per-device body; call inside shard_map with q/k/v local blocks.
+
+    kv_mask: optional [B, Lk_local] bool padding mask for this device's
+    keys; it rides the ring alongside its K/V block.
+    """
     orig_dtype = q.dtype
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -85,26 +94,37 @@ def _ring_attention_local(
     # which vary over the mesh axes of the enclosing shard_map); the scan
     # carry type must declare that up front.
     vma = tuple(jax.typeof(q).vma)
+
     if vma:
         o0, m0, l0 = (lax.pcast(t, vma, to="varying") for t in (o0, m0, l0))
+    if kv_mask is None:
+        mask0 = jnp.ones((b, lk), bool)
+        if vma:
+            # A provided kv_mask is already device-varying (it came through
+            # shard_map in_specs); only the constant stand-in needs the cast.
+            mask0 = lax.pcast(mask0, vma, to="varying")
+    else:
+        mask0 = kv_mask
 
     def step(carry, i):
-        o, m, l, k_blk, v_blk = carry
+        o, m, l, k_blk, v_blk, mask_blk = carry
         kv_idx = (my_idx - i) % axis_size  # whose block we hold at hop i
         o, m, l = _block_attend(
             q, k_blk, v_blk, o, m, l,
             q_offset=my_idx * lq, k_offset=kv_idx * lk,
             causal=causal, scale=scale,
+            kv_mask=None if kv_mask is None else mask_blk,
         )
-        # Rotate K/V to the next peer (skipped after the final fold would be
-        # ideal; one extra hop keeps the scan body uniform and XLA overlaps
-        # it with the epilogue anyway).
+        # Rotate K/V (and their padding mask) to the next peer (skipping the
+        # hop after the final fold would be ideal; one extra hop keeps the
+        # scan body uniform and XLA overlaps it with the epilogue anyway).
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (o, m, l, k_blk, v_blk), None
+        mask_blk = lax.ppermute(mask_blk, axis_name, perm)
+        return (o, m, l, k_blk, v_blk, mask_blk), None
 
-    (o, m, l, _, _), _ = lax.scan(
-        step, (o0, m0, l0, k, v), jnp.arange(axis_size)
+    (o, m, l, _, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v, mask0), jnp.arange(axis_size)
     )
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]  # [B,Lq,H,1]
     return (o / denom).astype(orig_dtype)
@@ -114,6 +134,7 @@ def ring_self_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    kv_mask: jax.Array | None = None,
     *,
     axis_name: str = "sp",
     causal: bool = False,
@@ -122,10 +143,11 @@ def ring_self_attention(
     """Ring attention on already-local [B, L/sp, H, D] blocks.
 
     Use this form inside a model that is itself under shard_map/pjit with
-    sequence dim sharded on ``axis_name``.
+    sequence dim sharded on ``axis_name``. ``kv_mask``: [B, L/sp] bool
+    padding mask for this device's keys.
     """
     return _ring_attention_local(
-        q, k, v, axis_name=axis_name, causal=causal, scale=scale
+        q, k, v, kv_mask, axis_name=axis_name, causal=causal, scale=scale
     )
 
 
@@ -135,6 +157,7 @@ def ring_attention(
     v: jax.Array,
     mesh: Mesh,
     *,
+    kv_mask: jax.Array | None = None,
     axis_name: str = "sp",
     causal: bool = False,
     scale: float | None = None,
@@ -144,11 +167,18 @@ def ring_attention(
 
     Shards the sequence dim over ``axis_name`` (and batch over
     ``batch_axes``), runs the ring, returns the global [B, L, H, D] result.
+    ``kv_mask``: optional [B, L] bool — False key positions (padding) are
+    excluded from attention.
     """
     spec = P(tuple(batch_axes), axis_name, None, None)
+    mask_spec = P(tuple(batch_axes), axis_name)
     fn = functools.partial(
         _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
     )
+    if kv_mask is None:
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )(q, k, v)
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
-    )(q, k, v)
+        fn, mesh=mesh, in_specs=(spec, spec, spec, mask_spec), out_specs=spec
+    )(q, k, v, kv_mask)

@@ -45,6 +45,10 @@ def _constrain(x: jax.Array, axis: str) -> jax.Array:
         raise ValueError(
             f"tp_axis {axis!r} not in the active mesh axes {mesh.axis_names}"
         )
+    if axis in getattr(mesh, "manual_axes", ()):
+        # Inside shard_map over this axis: arrays are already per-device
+        # blocks, there is nothing for GSPMD to constrain.
+        return x
     spec = P(*([P.UNCONSTRAINED] * (x.ndim - 1)), axis)
     return lax.with_sharding_constraint(x, spec)
 

@@ -1,9 +1,10 @@
 """Worker-process entry point for TPURunner's local-process backend.
 
 Launched as ``python -m sparkdl_tpu.runner._worker <payload> <rank> <np>
-<coordinator> <result_path>``. The payload (cloudpickle) carries the user fn,
-kwargs, and env overrides. Env/JAX setup must happen before jax initializes a
-backend, which is why this is a fresh process, not a fork.
+<coordinator> <result_path>``. The payload (cloudpickle) carries the user fn
+and kwargs; env overrides (JAX_PLATFORMS, XLA_FLAGS, ...) are set by the
+parent in this process's environment before exec, so they are in place
+before any import (sitecustomize may import jax at interpreter start).
 """
 
 from __future__ import annotations

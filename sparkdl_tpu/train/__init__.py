@@ -1,0 +1,16 @@
+"""Training loops and fine-tune drivers (the workloads TPURunner launches).
+
+Reference parity: HorovodRunner's user fn is an arbitrary training loop
+(SURVEY.md 3.4); these are the framework-provided equivalents for the
+BASELINE.md benchmark configs — ResNet ImageNet-style training and BERT
+fine-tuning — written as pure-JAX steps that shard over the mesh's data
+axes and run unchanged under one chip, a v5e slice, or the CPU test mesh.
+"""
+
+from sparkdl_tpu.train.finetune import (
+    TrainState,
+    classification_train_step,
+    finetune_classifier,
+)
+
+__all__ = ["TrainState", "classification_train_step", "finetune_classifier"]

@@ -57,6 +57,26 @@ def test_ring_grads_match_full(sp_mesh):
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gf), atol=1e-4)
 
 
+def test_ring_with_padding_mask_matches_full(sp_mesh):
+    """kv_mask path: padded keys excluded, matching masked full attention."""
+    rng = np.random.default_rng(3)
+    b, l, h, d = 4, 32, 2, 8
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((b, l, h, d), np.float32)) for _ in range(3)
+    )
+    mask_np = np.ones((b, l), bool)
+    mask_np[0, l // 2:] = False  # one row half padding
+    mask_np[1, 5:] = False
+    mask = jnp.asarray(mask_np)
+
+    got = ring_attention(q, k, v, sp_mesh, kv_mask=mask)
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
+    want = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
 def test_ring_under_jit(sp_mesh):
     rng = np.random.default_rng(2)
     b, l, h, d = 2, 32, 1, 8

@@ -1,0 +1,57 @@
+"""HPO engine: search-space sampling, fmin contract, failure tolerance."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.hpo import Trials, fmin, hp, sample_space
+
+
+def test_sample_space_kinds():
+    rng = np.random.default_rng(0)
+    space = {
+        "lr": hp.loguniform("lr", np.log(1e-5), np.log(1e-2)),
+        "dropout": hp.uniform("dropout", 0.0, 0.5),
+        "batch": hp.choice("batch", [16, 32, 64]),
+        "layers": hp.quniform("layers", 1, 4, 1),
+        "fixed": "adam",
+    }
+    s = sample_space(space, rng)
+    assert 1e-5 <= s["lr"] <= 1e-2
+    assert 0.0 <= s["dropout"] <= 0.5
+    assert s["batch"] in (16, 32, 64)
+    assert s["layers"] in (1.0, 2.0, 3.0, 4.0)
+    assert s["fixed"] == "adam"
+
+
+def test_fmin_finds_minimum():
+    space = {"x": hp.uniform("x", -5, 5)}
+    best = fmin(lambda p: (p["x"] - 2.0) ** 2, space,
+                max_evals=60, seed=1, use_hyperopt=False)
+    assert abs(best["x"] - 2.0) < 0.5
+
+
+def test_fmin_parallel_and_failures():
+    space = {"x": hp.uniform("x", 0, 1)}
+    calls = []
+
+    def objective(p):
+        calls.append(p)
+        if p["x"] > 0.8:
+            raise RuntimeError("boom")
+        return {"loss": p["x"], "status": "ok", "aux": 42}
+
+    trials = Trials()
+    best = fmin(objective, space, max_evals=20, seed=2, parallelism=4,
+                trials=trials, use_hyperopt=False)
+    assert len(trials.trials) == 20
+    assert any(t["status"] == "fail" for t in trials.trials) or all(
+        c["x"] <= 0.8 for c in calls
+    )
+    assert best["x"] == trials.best_trial["params"]["x"]
+    assert trials.best_trial.get("aux") == 42
+
+
+def test_trials_no_success_raises():
+    t = Trials(trials=[{"status": "fail", "loss": None}])
+    with pytest.raises(RuntimeError, match="no successful"):
+        _ = t.best_trial
