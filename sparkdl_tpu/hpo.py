@@ -125,16 +125,37 @@ def fmin(
     if use_hyperopt:
         if _hyperopt is None:
             raise RuntimeError("hyperopt requested but not installed")
+        if parallelism > 1:
+            logger.warning(
+                "hyperopt path runs trials serially (TPE is sequential); "
+                "parallelism=%d ignored — pass use_hyperopt=False for the "
+                "parallel random-search engine", parallelism,
+            )
         hp_space = {
             k: getattr(_hyperopt.hp, v.kind)(v.label, *(
-                (v.args[0],) if v.kind == "choice" else v.args
-            ))
+                (list(v.args[0]),) if v.kind == "choice" else v.args
+            )) if isinstance(v, _Dist) else v  # constants pass through
             for k, v in space.items()
         }
-        return _hyperopt.fmin(
+        ho_trials = _hyperopt.Trials()
+        best = _hyperopt.fmin(
             objective, hp_space, algo=_hyperopt.tpe.suggest,
             max_evals=max_evals, rstate=np.random.default_rng(seed),
+            trials=ho_trials,
         )
+        # space_eval decodes hp.choice indices back to option values so the
+        # return contract matches the built-in engine.
+        best = dict(_hyperopt.space_eval(hp_space, best))
+        if trials is not None:  # mirror the log into the caller's Trials
+            for i, t in enumerate(ho_trials.trials):
+                ok = t["result"].get("status") == _hyperopt.STATUS_OK
+                trials.trials.append({
+                    "tid": i,
+                    "params": None,  # hyperopt keeps vals encoded; see .misc
+                    "loss": t["result"].get("loss") if ok else None,
+                    "status": "ok" if ok else "fail",
+                })
+        return best
 
     trials = trials if trials is not None else Trials()
     rng = np.random.default_rng(seed)

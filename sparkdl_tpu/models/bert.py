@@ -104,6 +104,18 @@ class BertSelfAttention(nn.Module):
         q, k, v = (t.reshape(b, l, nh, hd) for t in (q, k, v))
 
         if c.attn_impl == "ring":
+            if train and c.attention_probs_dropout_prob > 0:
+                # Blockwise accumulation never materialises the probability
+                # matrix, so attention-probs dropout cannot be applied on
+                # the ring path (the usual flash-attention trade-off).
+                import warnings
+
+                warnings.warn(
+                    "attn_impl='ring' skips attention-probs dropout "
+                    f"(p={c.attention_probs_dropout_prob}); set "
+                    "attention_probs_dropout_prob=0 to silence",
+                    stacklevel=2,
+                )
             ctx = ring_self_attention(
                 q, k, v,
                 kv_mask=None if attention_mask is None else attention_mask,

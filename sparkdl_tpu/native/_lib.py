@@ -1,0 +1,109 @@
+"""Build-on-first-import loader + ctypes signatures for libsdlbridge.
+
+No pybind11 in the image, so the binding layer is ctypes over a plain C
+ABI (see csrc/sdl_bridge.cc). The .so is compiled lazily with g++ and
+cached under ``_build/``; environments without a toolchain simply get
+``lib() -> None`` and the pure-Python fallbacks in bridge.py take over.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "csrc", "sdl_bridge.cc")
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_SO = os.path.join(_BUILD_DIR, "libsdlbridge.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _compile() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+        "-o", _SO + ".tmp", _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)  # atomic: concurrent importers race safely
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        detail = getattr(e, "stderr", b"") or b""
+        logger.warning(
+            "sdl_bridge native build failed (%s); using pure-Python staging. %s",
+            e, detail.decode(errors="replace")[:500],
+        )
+        return False
+
+
+def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.sdl_ring_create.restype = c.c_void_p
+    lib.sdl_ring_create.argtypes = [c.c_uint64, c.c_uint32]
+    lib.sdl_ring_destroy.argtypes = [c.c_void_p]
+    lib.sdl_ring_slot_bytes.restype = c.c_uint64
+    lib.sdl_ring_slot_bytes.argtypes = [c.c_void_p]
+    lib.sdl_ring_n_slots.restype = c.c_uint32
+    lib.sdl_ring_n_slots.argtypes = [c.c_void_p]
+    lib.sdl_ring_slot_ptr.restype = c.POINTER(c.c_uint8)
+    lib.sdl_ring_slot_ptr.argtypes = [c.c_void_p, c.c_uint32]
+    lib.sdl_ring_acquire_write.restype = c.c_int64
+    lib.sdl_ring_acquire_write.argtypes = [c.c_void_p, c.c_double]
+    lib.sdl_ring_commit_write.argtypes = [c.c_void_p, c.c_uint32, c.c_uint64, c.c_uint64]
+    lib.sdl_ring_abort_write.argtypes = [c.c_void_p, c.c_uint32]
+    lib.sdl_ring_acquire_read.restype = c.c_int64
+    lib.sdl_ring_acquire_read.argtypes = [c.c_void_p, c.c_double]
+    lib.sdl_ring_slot_rows.restype = c.c_uint64
+    lib.sdl_ring_slot_rows.argtypes = [c.c_void_p, c.c_uint32]
+    lib.sdl_ring_slot_used.restype = c.c_uint64
+    lib.sdl_ring_slot_used.argtypes = [c.c_void_p, c.c_uint32]
+    lib.sdl_ring_release_read.argtypes = [c.c_void_p, c.c_uint32]
+    lib.sdl_ring_close.argtypes = [c.c_void_p]
+    lib.sdl_ring_closed.restype = c.c_int
+    lib.sdl_ring_closed.argtypes = [c.c_void_p]
+    lib.sdl_pack_rows.argtypes = [
+        c.POINTER(c.c_uint8), c.POINTER(c.POINTER(c.c_uint8)),
+        c.POINTER(c.c_uint64), c.c_uint64, c.c_uint64, c.c_uint64,
+        c.c_uint64, c.c_uint32,
+    ]
+    lib.sdl_u8_to_f32.argtypes = [
+        c.POINTER(c.c_float), c.POINTER(c.c_uint8), c.c_uint64,
+        c.c_float, c.c_float, c.c_uint32,
+    ]
+    return lib
+
+
+def lib() -> ctypes.CDLL | None:
+    """The loaded native library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("SPARKDL_TPU_DISABLE_NATIVE"):
+            logger.info("native bridge disabled via SPARKDL_TPU_DISABLE_NATIVE")
+            return None
+        if not os.path.exists(_SO) and not _compile():
+            return None
+        try:
+            _lib = _declare(ctypes.CDLL(_SO))
+        except OSError as e:  # stale/foreign .so
+            logger.warning("could not load %s: %s", _SO, e)
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None

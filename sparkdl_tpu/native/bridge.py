@@ -1,0 +1,330 @@
+"""Python surface over the native staging library.
+
+- :class:`StagingRing` — fixed-slot producer/consumer ring whose slots are
+  stable aligned C allocations (numpy views, zero-copy on the host side).
+- :func:`pack_rows` — threaded scatter of N rows into one padded
+  [bucket, row_stride] matrix (native memcpy fan-out; numpy fallback).
+- :class:`DeviceFeeder` — the double-buffered infeed: a packer thread fills
+  ring slots, a transfer thread device_puts each slot and recycles it only
+  after the copy lands, the consumer iterates device arrays while the next
+  batch is already in flight. This is the TensorFrames-block-feed
+  equivalent (SURVEY.md 2.15) in TPU-native form.
+
+Everything degrades to pure Python/numpy when the .so can't be built
+(``sparkdl_tpu.native.available()`` tells you which path is live).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import numpy as np
+
+from sparkdl_tpu.native import _lib
+
+
+def native_available() -> bool:
+    return _lib.available()
+
+
+# ---------------------------------------------------------------------------
+# Staging ring
+# ---------------------------------------------------------------------------
+
+class StagingRing:
+    """FIFO ring of fixed-size staging slots backed by native memory.
+
+    Producer: ``idx = acquire_write(); slot_view(idx)[...] = ...;
+    commit_write(idx, n_rows)``. Consumer: ``idx = acquire_read();
+    use slot_view(idx); release_read(idx)``. ``close()`` ends the stream;
+    readers then drain and get ``None``.
+    """
+
+    def __init__(self, slot_bytes: int, n_slots: int = 3):
+        l = _lib.lib()
+        if l is None:
+            raise RuntimeError(
+                "native bridge unavailable (build failed or disabled); "
+                "use the pure-Python prefetcher instead"
+            )
+        self._l = l
+        self._h = l.sdl_ring_create(slot_bytes, n_slots)
+        if not self._h:
+            raise MemoryError(f"could not allocate {n_slots}x{slot_bytes} ring")
+        self.slot_bytes = slot_bytes
+        self.n_slots = n_slots
+
+    def slot_view(self, idx: int) -> np.ndarray:
+        ptr = self._l.sdl_ring_slot_ptr(self._h, idx)
+        return np.ctypeslib.as_array(ptr, shape=(self.slot_bytes,))
+
+    def acquire_write(self, timeout_s: float = -1.0) -> int | None:
+        r = self._l.sdl_ring_acquire_write(self._h, timeout_s)
+        return None if r < 0 else int(r)
+
+    def commit_write(self, idx: int, n_rows: int, used_bytes: int = 0) -> None:
+        self._l.sdl_ring_commit_write(self._h, idx, n_rows, used_bytes)
+
+    def abort_write(self, idx: int) -> None:
+        self._l.sdl_ring_abort_write(self._h, idx)
+
+    def acquire_read(self, timeout_s: float = -1.0) -> int | None:
+        """Next committed slot index; None on timeout or end-of-stream
+        (distinguish via :meth:`closed`)."""
+        r = self._l.sdl_ring_acquire_read(self._h, timeout_s)
+        return None if r < 0 else int(r)
+
+    def slot_rows(self, idx: int) -> int:
+        return int(self._l.sdl_ring_slot_rows(self._h, idx))
+
+    def slot_used(self, idx: int) -> int:
+        return int(self._l.sdl_ring_slot_used(self._h, idx))
+
+    def release_read(self, idx: int) -> None:
+        self._l.sdl_ring_release_read(self._h, idx)
+
+    def close(self) -> None:
+        self._l.sdl_ring_close(self._h)
+
+    @property
+    def closed(self) -> bool:
+        return bool(self._l.sdl_ring_closed(self._h))
+
+    def destroy(self) -> None:
+        if self._h:
+            self._l.sdl_ring_destroy(self._h)
+            self._h = None
+
+    def __enter__(self) -> "StagingRing":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+        self.destroy()
+
+
+# ---------------------------------------------------------------------------
+# Row packing
+# ---------------------------------------------------------------------------
+
+def pack_rows(
+    rows: Sequence[np.ndarray],
+    *,
+    bucket: int | None = None,
+    row_stride: int | None = None,
+    out: np.ndarray | None = None,
+    n_threads: int = 4,
+) -> np.ndarray:
+    """Pack per-row byte arrays into a padded [bucket, row_stride] uint8
+    matrix; rows beyond ``len(rows)`` repeat row 0 (bucketed padding).
+
+    ``out`` may be a preallocated buffer (e.g. a ring ``slot_view`` slice)
+    to pack straight into staging memory.
+    """
+    if not rows:
+        raise ValueError("pack_rows needs at least one row")
+    srcs = [np.ascontiguousarray(r).view(np.uint8).reshape(-1) for r in rows]
+    n = len(srcs)
+    stride = row_stride or max(s.nbytes for s in srcs)
+    total = bucket or n
+    if total < n:
+        raise ValueError(f"bucket {total} < n_rows {n}")
+    if out is None:
+        out = np.empty(total * stride, np.uint8)
+    else:
+        out = out.view(np.uint8).reshape(-1)
+        if out.nbytes < total * stride:
+            raise ValueError("out buffer too small")
+
+    l = _lib.lib()
+    if l is None:
+        view = out[: total * stride].reshape(total, stride)
+        for i in range(total):
+            s = srcs[i] if i < n else srcs[0]
+            nb = min(s.nbytes, stride)
+            view[i, :nb] = s[:nb]
+            view[i, nb:] = 0
+        return view
+
+    ptrs = (ctypes.POINTER(ctypes.c_uint8) * n)(
+        *[s.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) for s in srcs]
+    )
+    sizes = (ctypes.c_uint64 * n)(*[s.nbytes for s in srcs])
+    l.sdl_pack_rows(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ptrs, sizes, n, total, 0, stride, n_threads,
+    )
+    return out[: total * stride].reshape(total, stride)
+
+
+def u8_to_f32(src: np.ndarray, scale: float = 1.0, bias: float = 0.0,
+              n_threads: int = 4) -> np.ndarray:
+    """Threaded uint8 -> float32 affine cast (numpy fallback without lib)."""
+    src = np.ascontiguousarray(src, np.uint8)
+    l = _lib.lib()
+    if l is None:
+        return src.astype(np.float32) * scale + bias
+    dst = np.empty(src.shape, np.float32)
+    l.sdl_u8_to_f32(
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        src.size, scale, bias, n_threads,
+    )
+    return dst
+
+
+# ---------------------------------------------------------------------------
+# Double-buffered device feeder
+# ---------------------------------------------------------------------------
+
+class DeviceFeeder:
+    """Iterate device arrays from a host batch stream with full overlap.
+
+    Pipeline: packer thread (host assembly into ring slots) -> transfer
+    thread (device_put from stable slot memory; slot recycled only after
+    the transfer completes) -> consumer (this iterator). With n_slots >= 2
+    the host is packing batch i+2 while batch i+1 is on the wire and batch
+    i is computing: the double-buffered infeed.
+
+    ``batches``: yields np.ndarray (single-tensor feed) of identical dtype;
+    shapes may vary in the leading dim only. ``transfer`` defaults to
+    jax.device_put (pass a sharded device_put for multi-chip feeds).
+    """
+
+    def __init__(
+        self,
+        batches: Iterable[np.ndarray],
+        *,
+        n_slots: int = 3,
+        transfer: Callable[[np.ndarray], Any] | None = None,
+        max_batch_bytes: int | None = None,
+    ):
+        self._batches = batches
+        self._n_slots = n_slots
+        self._transfer = transfer
+        self._max_bytes = max_batch_bytes
+
+    def __iter__(self) -> Iterator[Any]:
+        import jax
+
+        transfer = self._transfer or jax.device_put
+        it = iter(self._batches)
+        try:
+            first = next(it)
+        except StopIteration:
+            return
+        first = np.ascontiguousarray(first)
+        slot_bytes = self._max_bytes or first.nbytes
+        if not native_available():
+            # Pure-Python path: same overlap via the prefetch queue.
+            from sparkdl_tpu.runtime.prefetch import prefetch_to_device
+
+            def chain():
+                yield first
+                yield from it
+
+            yield from prefetch_to_device(chain(), size=self._n_slots - 1,
+                                          transfer=transfer)
+            return
+
+        ring = StagingRing(slot_bytes, self._n_slots)
+        meta: dict[int, tuple] = {}  # slot idx -> (shape, dtype)
+        out_q: queue.Queue = queue.Queue(maxsize=self._n_slots)
+        stop = threading.Event()
+        errors: list[BaseException] = []
+        SENTINEL = object()
+
+        def packer():
+            try:
+                for batch in self._chain(first, it):
+                    batch = np.ascontiguousarray(batch)
+                    if batch.nbytes > slot_bytes:
+                        raise ValueError(
+                            f"batch of {batch.nbytes}B exceeds slot size "
+                            f"{slot_bytes}B (set max_batch_bytes)"
+                        )
+                    idx = None
+                    while idx is None and not stop.is_set():
+                        idx = ring.acquire_write(timeout_s=0.1)
+                    if idx is None:
+                        return
+                    view = ring.slot_view(idx)
+                    view[: batch.nbytes] = batch.view(np.uint8).reshape(-1)
+                    meta[idx] = (batch.shape, batch.dtype)
+                    ring.commit_write(idx, batch.shape[0], batch.nbytes)
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                ring.close()
+
+        # On CPU backends jax.device_put is zero-copy for aligned numpy
+        # arrays — the "device" array would alias the slot and be corrupted
+        # when the slot recycles. Accelerators copy to HBM, so the slot can
+        # be released once the transfer lands.
+        needs_copy = jax.default_backend() == "cpu"
+
+        def transferrer():
+            try:
+                while not stop.is_set():
+                    idx = ring.acquire_read(timeout_s=0.1)
+                    if idx is None:
+                        if ring.closed:
+                            break
+                        continue
+                    shape, dtype = meta.pop(idx)
+                    used = ring.slot_used(idx)
+                    host = ring.slot_view(idx)[:used].view(dtype).reshape(shape)
+                    if needs_copy:
+                        host = np.array(host, copy=True)
+                    arr = transfer(host)
+                    # The slot must stay stable until the device copy is
+                    # done; block on THIS thread (the consumer keeps
+                    # computing meanwhile), then recycle the slot.
+                    jax.block_until_ready(arr)
+                    ring.release_read(idx)
+                    while not stop.is_set():
+                        try:
+                            out_q.put(arr, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                # Blocking put: the consumer is draining the queue, so this
+                # succeeds; if the consumer abandoned (stop set), give up —
+                # never steal queued results to make room.
+                while True:
+                    try:
+                        out_q.put(SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        if stop.is_set():
+                            break
+
+        t1 = threading.Thread(target=packer, daemon=True)
+        t2 = threading.Thread(target=transferrer, daemon=True)
+        t1.start()
+        t2.start()
+        try:
+            while True:
+                item = out_q.get()
+                if item is SENTINEL:
+                    if errors:
+                        raise errors[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+            ring.close()
+            t1.join(timeout=5)
+            t2.join(timeout=5)
+            ring.destroy()
+
+    @staticmethod
+    def _chain(first, rest):
+        yield first
+        yield from rest

@@ -97,14 +97,7 @@ def _ring_attention_local(
 
     if vma:
         o0, m0, l0 = (lax.pcast(t, vma, to="varying") for t in (o0, m0, l0))
-    if kv_mask is None:
-        mask0 = jnp.ones((b, lk), bool)
-        if vma:
-            # A provided kv_mask is already device-varying (it came through
-            # shard_map in_specs); only the constant stand-in needs the cast.
-            mask0 = lax.pcast(mask0, vma, to="varying")
-    else:
-        mask0 = kv_mask
+    masked = kv_mask is not None
 
     def step(carry, i):
         o, m, l, k_blk, v_blk, mask_blk = carry
@@ -113,19 +106,20 @@ def _ring_attention_local(
             q, k_blk, v_blk, o, m, l,
             q_offset=my_idx * lq, k_offset=kv_idx * lk,
             causal=causal, scale=scale,
-            kv_mask=None if kv_mask is None else mask_blk,
+            kv_mask=mask_blk if masked else None,
         )
-        # Rotate K/V (and their padding mask) to the next peer (skipping the
-        # hop after the final fold would be ideal; one extra hop keeps the
-        # scan body uniform and XLA overlaps it with the epilogue anyway).
+        # Rotate K/V (and the padding mask, when present) to the next peer
+        # (skipping the hop after the final fold would be ideal; one extra
+        # hop keeps the scan body uniform and XLA overlaps it with the
+        # epilogue anyway).
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        mask_blk = lax.ppermute(mask_blk, axis_name, perm)
+        if masked:
+            mask_blk = lax.ppermute(mask_blk, axis_name, perm)
         return (o, m, l, k_blk, v_blk, mask_blk), None
 
-    (o, m, l, _, _, _), _ = lax.scan(
-        step, (o0, m0, l0, k, v, mask0), jnp.arange(axis_size)
-    )
+    carry0 = (o0, m0, l0, k, v, kv_mask if masked else jnp.zeros((), bool))
+    (o, m, l, *_), _ = lax.scan(step, carry0, jnp.arange(axis_size))
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]  # [B,Lq,H,1]
     return (o / denom).astype(orig_dtype)
 

@@ -57,11 +57,18 @@ def pad_to_bucket(arrays: dict[str, np.ndarray], buckets: Sequence[int]) -> Padd
     bucket = pick_bucket(n, buckets)
     if bucket == n:
         return PaddedBatch(arrays, n, bucket)
-    padded = {}
-    for k, a in arrays.items():
-        pad_rows = np.repeat(a[:1], bucket - n, axis=0)
-        padded[k] = np.concatenate([a, pad_rows], axis=0)
-    return PaddedBatch(padded, n, bucket)
+    return PaddedBatch(
+        {k: _repeat_pad(a, bucket) for k, a in arrays.items()}, n, bucket
+    )
+
+
+def _repeat_pad(a: np.ndarray, bucket: int) -> np.ndarray:
+    """Pad rows [n:bucket] with copies of row 0 (the PaddedBatch contract —
+    the single place the padding convention lives)."""
+    n = a.shape[0]
+    if bucket == n:
+        return a
+    return np.concatenate([a, np.repeat(a[:1], bucket - n, axis=0)], axis=0)
 
 
 def rebatch(
@@ -86,10 +93,32 @@ def rebatch(
         yield _stack(pending, buckets)
 
 
+#: below this many bytes per assembled tensor, plain np.stack wins (thread
+#: spawn overhead exceeds the memcpy fan-out gain)
+_NATIVE_PACK_MIN_BYTES = 1 << 20
+
+
 def _stack(rows: list[dict[str, np.ndarray]], buckets: Sequence[int]) -> PaddedBatch:
     keys = rows[0].keys()
-    arrays = {k: np.stack([r[k] for r in rows], axis=0) for k in keys}
-    return pad_to_bucket(arrays, buckets)
+    n = len(rows)
+    bucket = pick_bucket(n, buckets)
+    arrays = {k: _assemble([np.asarray(r[k]) for r in rows], bucket)
+              for k in keys}
+    return PaddedBatch(arrays, n, bucket)
+
+
+def _assemble(vals: list[np.ndarray], bucket: int) -> np.ndarray:
+    """Stack + pad rows to [bucket, ...]; large batches go through the
+    native threaded packer (sparkdl_tpu.native), small ones through numpy."""
+    v0 = vals[0]
+    if (v0.nbytes * bucket >= _NATIVE_PACK_MIN_BYTES
+            and all(v.shape == v0.shape and v.dtype == v0.dtype for v in vals)):
+        from sparkdl_tpu.native import bridge
+
+        if bridge.native_available():
+            packed = bridge.pack_rows(vals, bucket=bucket, row_stride=v0.nbytes)
+            return packed.view(v0.dtype).reshape((bucket,) + v0.shape)
+    return _repeat_pad(np.stack(vals, axis=0), bucket)
 
 
 def pad_batch_to_multiple(arrays: dict[str, np.ndarray], multiple: int) -> PaddedBatch:
