@@ -1,0 +1,25 @@
+"""Framework-managed checkpoint/resume.
+
+The reference has no checkpoint subsystem: its docs tell users to hang a
+Keras ``ModelCheckpoint``/``hvd.callbacks`` off the training loop and write
+to DBFS from rank 0 (SURVEY.md §5 "Checkpoint / resume" — user-level only).
+Here checkpointing is first-class, the TPU-native way: async sharded Orbax
+saves of the full train state (params / opt_state / step), coordinated
+across hosts, restored back into the same mesh/shardings for resume after a
+barrier-stage retry (SURVEY.md §5 "Failure detection": barrier is
+all-or-nothing, restart resumes from checkpoint).
+"""
+
+from sparkdl_tpu.checkpoint.manager import (
+    CheckpointManager,
+    latest_step,
+    restore_matching,
+    save_and_wait,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "latest_step",
+    "restore_matching",
+    "save_and_wait",
+]

@@ -1,0 +1,133 @@
+"""Async sharded checkpointing on Orbax (TPU-native resume story).
+
+Design (SURVEY.md §5, §7 L-aux): every save is asynchronous — the host
+snapshot is taken synchronously (cheap), the serialization/write happens on
+a background thread while the next train steps run; ``wait()``/``close()``
+drains. Multi-host coordination, atomicity (tmp dir + rename) and garbage
+collection of old steps are Orbax's job; this module pins the framework's
+conventions on top:
+
+* one item named ``state`` holding the whole train-state pytree;
+* restore-with-shardings: the caller passes a template pytree (e.g. the
+  freshly initialized, device-put train state) and gets the checkpoint back
+  with each leaf materialized on the template leaf's sharding — resume
+  drops straight back into the same mesh;
+* ``keep`` bounds disk usage (old steps GC'd).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+
+def _abstract_like(tree: Any):
+    """Template pytree -> abstract (shape/dtype/sharding) restore target."""
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x  # scalars / python leaves restore as saved
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+class CheckpointManager:
+    """Thin framework wrapper over ``orbax.checkpoint.CheckpointManager``.
+
+    >>> ckpt = CheckpointManager(dir, keep=3)
+    >>> ckpt.save(step, state)            # async; returns immediately
+    >>> state = ckpt.restore(template=state)   # latest step, same shardings
+    >>> ckpt.close()
+    """
+
+    def __init__(self, directory: str | os.PathLike, *, keep: int = 3,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.fspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    # -- save ----------------------------------------------------------------
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Queue an async save of ``state`` at ``step``.
+
+        Returns False when the manager's save_interval policy skipped it
+        (``force=True`` bypasses the policy — used for the final step).
+        """
+        return self._mgr.save(
+            int(step), args=self._ocp.args.StandardSave(state), force=force
+        )
+
+    def wait(self) -> None:
+        """Block until every queued async save has landed on disk."""
+        self._mgr.wait_until_finished()
+
+    # -- restore -------------------------------------------------------------
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    def restore(self, step: int | None = None, *, template: Any) -> Any:
+        """Restore ``step`` (default: latest) shaped/sharded like ``template``.
+
+        Each ``jax.Array`` leaf of the template contributes its sharding, so
+        the restored state lands distributed across the same mesh it was
+        initialized for — no host-memory spike, no manual device_put.
+        """
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}"
+            )
+        return self._mgr.restore(
+            int(step),
+            args=self._ocp.args.StandardRestore(_abstract_like(template)),
+        )
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- module-level conveniences (single-shot paths) ---------------------------
+
+def save_and_wait(directory: str | os.PathLike, step: int, state: Any) -> None:
+    """Synchronous one-shot save (estimator/model export paths)."""
+    with CheckpointManager(directory) as mgr:
+        mgr.save(step, state)
+
+
+def latest_step(directory: str | os.PathLike) -> int | None:
+    import orbax.checkpoint as ocp
+
+    if not os.path.isdir(directory):
+        return None
+    with ocp.CheckpointManager(os.fspath(directory)) as mgr:
+        return mgr.latest_step()
+
+
+def restore_matching(directory: str | os.PathLike, template: Any,
+                     step: int | None = None) -> Any:
+    """One-shot restore shaped/sharded like ``template``."""
+    with CheckpointManager(directory) as mgr:
+        return mgr.restore(step, template=template)

@@ -149,9 +149,16 @@ def fmin(
         if trials is not None:  # mirror the log into the caller's Trials
             for i, t in enumerate(ho_trials.trials):
                 ok = t["result"].get("status") == _hyperopt.STATUS_OK
+                # hyperopt stores encoded vals ({label: [v]}); decode each
+                # trial through space_eval so params holds real option
+                # values and trials.best_trial["params"] stays usable.
+                vals = {
+                    k: v[0]
+                    for k, v in t["misc"]["vals"].items() if v
+                }
                 trials.trials.append({
                     "tid": i,
-                    "params": None,  # hyperopt keeps vals encoded; see .misc
+                    "params": dict(_hyperopt.space_eval(hp_space, vals)),
                     "loss": t["result"].get("loss") if ok else None,
                     "status": "ok" if ok else "fail",
                 })

@@ -28,14 +28,18 @@ _tried = False
 
 def _compile() -> bool:
     os.makedirs(_BUILD_DIR, exist_ok=True)
+    # per-process tmp name: concurrent first imports (several executor
+    # processes on one host) must not write through the same tmp inode;
+    # whichever os.replace lands last wins, both are valid builds.
+    tmp = f"{_SO}.tmp.{os.getpid()}"
     cmd = [
         os.environ.get("CXX", "g++"),
         "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-        "-o", _SO + ".tmp", _SRC,
+        "-o", tmp, _SRC,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(_SO + ".tmp", _SO)  # atomic: concurrent importers race safely
+        os.replace(tmp, _SO)  # atomic publish
         return True
     except (OSError, subprocess.SubprocessError) as e:
         detail = getattr(e, "stderr", b"") or b""
@@ -95,11 +99,21 @@ def lib() -> ctypes.CDLL | None:
         if os.environ.get("SPARKDL_TPU_DISABLE_NATIVE"):
             logger.info("native bridge disabled via SPARKDL_TPU_DISABLE_NATIVE")
             return None
-        if not os.path.exists(_SO) and not _compile():
-            return None
+        # Rebuild when the cached .so predates the source (git pull with a
+        # persisting _build/), not only when it is absent.
+        stale = (
+            os.path.exists(_SO)
+            and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        )
+        if (not os.path.exists(_SO) or stale) and not _compile():
+            if not os.path.exists(_SO):
+                return None  # no cached build to fall back to
         try:
             _lib = _declare(ctypes.CDLL(_SO))
-        except OSError as e:  # stale/foreign .so
+        except (OSError, AttributeError) as e:
+            # OSError: corrupt/foreign .so. AttributeError: a cached build
+            # missing a newer export — either way fall back to pure Python
+            # instead of letting the error escape into every batch assembly.
             logger.warning("could not load %s: %s", _SO, e)
             _lib = None
         return _lib

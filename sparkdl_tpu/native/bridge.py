@@ -226,7 +226,10 @@ class DeviceFeeder:
                 yield first
                 yield from it
 
-            yield from prefetch_to_device(chain(), size=self._n_slots - 1,
+            # size must stay >=1: Queue(maxsize=0) is UNbounded, the
+            # opposite of the tight buffering n_slots=1 asks for.
+            yield from prefetch_to_device(chain(),
+                                          size=max(1, self._n_slots - 1),
                                           transfer=transfer)
             return
 

@@ -212,11 +212,17 @@ void sdl_pack_rows(uint8_t* dst, const uint8_t* const* srcs,
         std::memcpy(out, srcs[i], nb);
         if (nb < row_stride) std::memset(out + nb, 0, row_stride - nb);
       } else {
-        // padding row: replicate pad_src_row's packed form
-        const uint64_t j = pad_src_row < n_rows ? pad_src_row : 0;
-        const uint64_t nb = src_bytes[j] < row_stride ? src_bytes[j] : row_stride;
-        std::memcpy(out, srcs[j], nb);
-        if (nb < row_stride) std::memset(out + nb, 0, row_stride - nb);
+        // padding row: replicate pad_src_row's packed form; with no source
+        // rows at all (n_rows==0, pad-only call) pad with zeros — srcs is
+        // empty, so there is nothing to replicate.
+        if (n_rows == 0) {
+          std::memset(out, 0, row_stride);
+        } else {
+          const uint64_t j = pad_src_row < n_rows ? pad_src_row : 0;
+          const uint64_t nb = src_bytes[j] < row_stride ? src_bytes[j] : row_stride;
+          std::memcpy(out, srcs[j], nb);
+          if (nb < row_stride) std::memset(out + nb, 0, row_stride - nb);
+        }
       }
     }
   };

@@ -1,0 +1,36 @@
+"""Metrics, profiling and health — the observability the reference lacks.
+
+The reference's story is "Spark executor logs + whatever TF timeline offers"
+(SURVEY.md §5 "Tracing / profiling": absent as a subsystem; "Metrics": thin
+stdout piping). The TPU build makes this first-class:
+
+* :mod:`sparkdl_tpu.observability.metrics` — step-time / examples-per-sec
+  per chip / MFU / infeed-starvation meters, with compiled-FLOPs lookup from
+  XLA cost analysis;
+* :mod:`sparkdl_tpu.observability.profiling` — ``jax.profiler`` trace
+  capture (Perfetto/XPlane) as a context manager plus a per-host trace
+  server;
+* :mod:`sparkdl_tpu.observability.health` — device/collective health probe
+  run before ``jax.distributed`` training starts (SURVEY.md §5 "Failure
+  detection": TPU slice health check before initialize).
+"""
+
+from sparkdl_tpu.observability.health import HealthReport, check_health
+from sparkdl_tpu.observability.metrics import (
+    StepMeter,
+    aggregate_across_hosts,
+    compiled_flops,
+    device_peak_flops,
+)
+from sparkdl_tpu.observability.profiling import start_trace_server, trace
+
+__all__ = [
+    "HealthReport",
+    "StepMeter",
+    "aggregate_across_hosts",
+    "check_health",
+    "compiled_flops",
+    "device_peak_flops",
+    "start_trace_server",
+    "trace",
+]

@@ -1,0 +1,147 @@
+"""Pre-flight device/collective health probe.
+
+SURVEY.md §5 "Failure detection": the reference inherits Spark's semantics
+only — barrier stage is all-or-nothing, no health checking. The TPU build
+adds a slice health check run by each TPURunner worker *after*
+``jax.distributed.initialize`` and *before* the user's train_fn: if a chip
+is wedged or ICI is degraded, fail fast inside the barrier task (cheap
+retry) instead of 40 minutes into compilation or training.
+
+The probe is deliberately tiny: enumerate local devices, run one addition
+per device (exercises the runtime path to every chip), and one global psum
+across all devices of all hosts (exercises ICI/DCN collectives end-to-end).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class HealthReport:
+    ok: bool
+    n_local_devices: int
+    n_global_devices: int
+    process_index: int
+    process_count: int
+    platform: str
+    device_kinds: list[str]
+    probe_time_s: float
+    collective_ok: bool
+    error: str | None = None
+
+    def summary(self) -> str:
+        state = "OK" if self.ok else f"UNHEALTHY: {self.error}"
+        return (
+            f"[health] {state} — process {self.process_index}/"
+            f"{self.process_count}, {self.n_local_devices} local / "
+            f"{self.n_global_devices} global {self.platform} devices, "
+            f"probe {self.probe_time_s * 1e3:.0f} ms"
+        )
+
+
+def check_health(*, collective: bool = True,
+                 expect_local_devices: int | None = None) -> HealthReport:
+    """Probe every local chip and (optionally) the global collective path.
+
+    Raises nothing: always returns a report; caller decides whether a
+    not-ok report aborts the barrier task.
+    """
+    t0 = time.perf_counter()
+    error = None
+    collective_ok = False
+    local = []
+    try:
+        local = jax.local_devices()
+        # one tiny computation per local device — catches a wedged chip
+        for d in local:
+            y = jax.device_put(jnp.ones((8,), jnp.float32), d) + 1.0
+            np.testing.assert_allclose(np.asarray(y), 2.0)
+        if expect_local_devices is not None and len(local) != expect_local_devices:
+            raise RuntimeError(
+                f"expected {expect_local_devices} local devices, "
+                f"found {len(local)}"
+            )
+    except Exception as e:  # report, don't raise — caller decides
+        error = f"{type(e).__name__}: {e}"
+    if collective:
+        # Global reduction over every device of every process: the same
+        # ICI/DCN path gradient sync will take. EVERY rank enters this,
+        # even one whose local probe failed — a rank that bailed out here
+        # would leave its healthy peers blocked inside the collective until
+        # the runtime's barrier timeout, the slow failure mode this probe
+        # exists to avoid. A wedged chip either fails fast below or hangs
+        # all ranks uniformly (handled by the runtime's own timeout).
+        try:
+            n = jax.device_count()
+            mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("d",))
+            ones = jax.device_put(
+                jnp.ones((n,), jnp.float32),
+                jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec("d")
+                ),
+            )
+            total = float(jnp.sum(ones))  # cross-device reduction
+            if total != float(n):
+                raise RuntimeError(f"collective sum {total} != {n}")
+            collective_ok = True
+        except Exception as e:
+            error = error or f"{type(e).__name__}: {e}"
+    try:
+        n_global = jax.device_count()
+    except Exception:
+        n_global = 0
+    return HealthReport(
+        ok=error is None,
+        n_local_devices=len(local),
+        n_global_devices=n_global,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        platform=jax.default_backend(),
+        device_kinds=sorted({d.device_kind for d in local}),
+        probe_time_s=time.perf_counter() - t0,
+        collective_ok=collective_ok,
+        error=error,
+    )
+
+
+def preflight(*, skip: bool = False, profiler_port: int | None = None,
+              rank: int = 0) -> HealthReport | None:
+    """Shared TPURunner worker pre-flight, called after
+    ``jax.distributed.initialize`` on every rank.
+
+    Runs the health probe (unless ``skip``) and raises RuntimeError on an
+    unhealthy report so the barrier task fails fast; optionally starts a
+    live profiler server on ``profiler_port + rank``. The *caller* resolves
+    the two knobs from wherever they are authoritative — on the driver for
+    the Spark backend (executor environments don't inherit the driver's),
+    from the local environment for the local-process backend.
+    """
+    report = None
+    if not skip:
+        report = check_health()
+        print(report.summary(), file=sys.stderr)
+        if not report.ok:
+            raise RuntimeError(report.summary())
+    if profiler_port is not None:
+        from sparkdl_tpu.observability.profiling import start_trace_server
+
+        start_trace_server(int(profiler_port) + rank)
+    return report
+
+
+def preflight_env_opts() -> dict:
+    """Read the preflight knobs from this process's environment (truthy
+    convention, matching SPARKDL_TPU_DISABLE_NATIVE)."""
+    port = os.environ.get("SPARKDL_TPU_PROFILER_PORT")
+    return {
+        "skip": bool(os.environ.get("SPARKDL_TPU_SKIP_HEALTH_CHECK")),
+        "profiler_port": int(port) if port else None,
+    }

@@ -1,0 +1,218 @@
+"""Step-time / throughput / MFU / infeed meters.
+
+The headline numbers this framework is scored on are images/sec/chip and
+MFU (BASELINE.md targets); this module is where they are measured, the same
+way in tests, benches and production runs.
+
+MFU definition used throughout: ``achieved FLOP/s / peak FLOP/s``, with
+achieved = (model FLOPs per step, from XLA's compiled cost analysis or a
+caller-supplied analytic count) / measured step wall time, and peak = the
+per-chip matrix-unit peak for the platform x dtype, times chips. This is
+*model* FLOPs utilization (the "How to Scale Your Model" convention), not
+hardware-counter utilization — rematerialized FLOPs don't inflate it when
+the caller supplies the analytic count.
+"""
+
+from __future__ import annotations
+
+import collections
+import statistics
+import time
+from typing import Any, Callable
+
+import jax
+
+#: Peak dense matmul FLOP/s per chip. bf16 figures from public TPU/GPU
+#: datasheets; fp32 is the bf16 number /2 on TPU (the MXU computes in bf16
+#: with fp32 accumulate; pure-fp32 runs at half rate on v4/v5).
+_PEAK_FLOPS: dict[str, float] = {
+    # TPU generations (per chip, bf16)
+    "v6e": 918e12,
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 46e12,
+}
+
+
+def device_peak_flops(device: "jax.Device | None" = None,
+                      dtype: str = "bf16") -> float | None:
+    """Best-effort peak FLOP/s of one chip; None when unknown (CPU, etc.)."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    peak = None
+    for tag, flops in _PEAK_FLOPS.items():
+        if tag in kind.replace(" ", ""):
+            peak = flops
+            break
+    if peak is None and "tpu" in kind:
+        peak = _PEAK_FLOPS["v5e"]  # conservative default for unknown TPUs
+    if peak is not None and dtype in ("f32", "fp32", "float32"):
+        peak /= 2
+    return peak
+
+
+def compiled_flops(fn: Callable, *args: Any, **kwargs: Any) -> float | None:
+    """FLOPs of one call of jitted ``fn`` per XLA's cost analysis.
+
+    Returns None when the backend doesn't report cost analysis. ``fn`` may
+    already be jitted or plain; args may be concrete arrays or
+    ShapeDtypeStructs (lowering is abstract either way).
+    """
+    try:
+        jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+        compiled = jitted.lower(*args, **kwargs).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        flops = cost.get("flops")
+        return float(flops) if flops and flops > 0 else None
+    except Exception:
+        return None
+
+
+class StepMeter:
+    """Accumulates per-step timings into throughput / MFU / infeed metrics.
+
+    Usage inside a training or inference loop::
+
+        meter = StepMeter(flops_per_example=..., n_chips=jax.device_count())
+        for batch in data:
+            with meter.step(examples=len(batch)):
+                out = step_fn(state, batch)
+                jax.block_until_ready(out)
+            # optionally: meter.note_infeed_wait(seconds)
+
+    ``summary()`` returns the structured per-host metrics dict SURVEY.md §5
+    calls for (step time, examples/sec/chip, infeed-starvation %, MFU).
+    """
+
+    def __init__(self, *, flops_per_example: float | None = None,
+                 flops_per_step: float | None = None,
+                 n_chips: int | None = None,
+                 peak_flops_per_chip: float | None = None,
+                 window: int = 50, warmup_steps: int = 1):
+        self.flops_per_example = flops_per_example
+        self.flops_per_step = flops_per_step
+        self.n_chips = n_chips if n_chips is not None else jax.device_count()
+        self.peak_flops_per_chip = (
+            peak_flops_per_chip
+            if peak_flops_per_chip is not None
+            else device_peak_flops()
+        )
+        self.warmup_steps = warmup_steps
+        self._times = collections.deque(maxlen=window)
+        self._examples = collections.deque(maxlen=window)
+        self._infeed = collections.deque(maxlen=window)
+        self._seen = 0
+        self._total_examples = 0
+
+    # -- recording -----------------------------------------------------------
+    class _StepCtx:
+        def __init__(self, meter: "StepMeter", examples: int):
+            self._m, self._ex = meter, examples
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, exc_type, *exc):
+            if exc_type is None:
+                self._m.record(time.perf_counter() - self._t0, self._ex)
+
+    def step(self, examples: int = 0) -> "_StepCtx":
+        return StepMeter._StepCtx(self, examples)
+
+    def record(self, step_time_s: float, examples: int = 0,
+               infeed_wait_s: float = 0.0) -> None:
+        self._seen += 1
+        if self._seen <= self.warmup_steps:  # compile step poisons the mean
+            return
+        self._times.append(step_time_s)
+        self._examples.append(examples)
+        self._infeed.append(infeed_wait_s)
+        self._total_examples += examples
+
+    def note_infeed_wait(self, seconds: float) -> None:
+        """Attribute host-input stall time to the most recent step."""
+        if self._infeed:
+            self._infeed[-1] += seconds
+
+    # -- derived metrics -----------------------------------------------------
+    @property
+    def steps_recorded(self) -> int:
+        return len(self._times)
+
+    def mean_step_time(self) -> float | None:
+        return statistics.fmean(self._times) if self._times else None
+
+    def examples_per_sec(self) -> float | None:
+        t = sum(self._times)
+        return sum(self._examples) / t if t > 0 else None
+
+    def examples_per_sec_per_chip(self) -> float | None:
+        eps = self.examples_per_sec()
+        return eps / self.n_chips if eps is not None else None
+
+    def infeed_starvation_pct(self) -> float | None:
+        t = sum(self._times)
+        return 100.0 * sum(self._infeed) / t if t > 0 else None
+
+    def achieved_flops_per_sec(self) -> float | None:
+        t = sum(self._times)
+        if t <= 0:
+            return None
+        if self.flops_per_step is not None:
+            return self.flops_per_step * len(self._times) / t
+        if self.flops_per_example is not None:
+            return self.flops_per_example * sum(self._examples) / t
+        return None
+
+    def mfu(self) -> float | None:
+        achieved = self.achieved_flops_per_sec()
+        peak = self.peak_flops_per_chip
+        if achieved is None or not peak:
+            return None
+        return achieved / (peak * self.n_chips)
+
+    def summary(self) -> dict[str, float | int | None]:
+        return {
+            "steps": self.steps_recorded,
+            "total_examples": self._total_examples,
+            "step_time_mean_s": self.mean_step_time(),
+            "examples_per_sec": self.examples_per_sec(),
+            "examples_per_sec_per_chip": self.examples_per_sec_per_chip(),
+            "infeed_starvation_pct": self.infeed_starvation_pct(),
+            "mfu": self.mfu(),
+            "n_chips": self.n_chips,
+        }
+
+
+def aggregate_across_hosts(metrics: dict[str, float | None]) -> dict:
+    """All-hosts mean/min/max of each numeric metric, identical on every
+    host (SURVEY.md §5: per-host metrics aggregated to the driver).
+
+    Single-process (the common test path) returns mean=min=max=value.
+    """
+    import numpy as np
+
+    keys = sorted(k for k, v in metrics.items() if isinstance(v, (int, float)))
+    local = np.asarray([float(metrics[k]) for k in keys], np.float64)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        stacked = multihost_utils.process_allgather(local)
+    else:
+        stacked = local[None]
+    out: dict[str, dict[str, float]] = {}
+    for i, k in enumerate(keys):
+        col = stacked[:, i]
+        out[k] = {
+            "mean": float(col.mean()),
+            "min": float(col.min()),
+            "max": float(col.max()),
+        }
+    return out

@@ -1,0 +1,51 @@
+"""Profiler hookup: per-host trace capture and trace server.
+
+SURVEY.md §5 "Tracing / profiling": the reference has nothing in-repo; the
+TPU equivalent is ``jax.profiler`` — XPlane/Perfetto traces showing XLA op
+timing, infeed gaps and ICI collective overlap. Two entry points:
+
+* :func:`trace` — capture a trace of a code block to a logdir (viewable in
+  TensorBoard's profile plugin / Perfetto);
+* :func:`start_trace_server` — long-lived per-host server so an operator
+  can attach and sample a live job (the TPURunner worker starts one when
+  ``SPARKDL_TPU_PROFILER_PORT`` is set).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str | os.PathLike,
+          create_perfetto_trace: bool = False) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace of the enclosed block into ``logdir``.
+
+    Remember to ``jax.block_until_ready`` the last output inside the block,
+    otherwise async dispatch leaks device work past the capture window.
+    """
+    jax.profiler.start_trace(
+        os.fspath(logdir), create_perfetto_trace=create_perfetto_trace
+    )
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_trace_server(port: int = 9999):
+    """Start the live profiling server on this host (one per process)."""
+    return jax.profiler.start_server(port)
+
+
+def annotate(name: str):
+    """Named region that shows up on the trace timeline (host + device).
+
+    Use around logical phases of a step (decode / infeed / apply) so the
+    Perfetto view maps back to framework stages.
+    """
+    return jax.profiler.TraceAnnotation(name)

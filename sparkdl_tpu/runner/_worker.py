@@ -38,6 +38,18 @@ def main(argv: list[str]) -> int:
         process_id=rank,
     )
 
+    # Pre-flight slice health probe + optional profiler server (SURVEY.md §5
+    # failure detection): fail fast if a chip or the collective path is bad,
+    # before the user's train_fn compiles anything. Local mode shares the
+    # parent's host, so the env knobs (SPARKDL_TPU_SKIP_HEALTH_CHECK /
+    # SPARKDL_TPU_PROFILER_PORT) are read right here.
+    from sparkdl_tpu.observability.health import preflight, preflight_env_opts
+
+    try:
+        preflight(rank=rank, **preflight_env_opts())
+    except RuntimeError:
+        return 2
+
     fn = payload["fn"]
     kwargs = payload["kwargs"]
     try:

@@ -192,6 +192,11 @@ class SparkBarrierBackend:
 
         payload = cloudpickle.dumps({"fn": fn, "kwargs": kwargs})
         sc = self.spark.sparkContext
+        # Preflight knobs resolve on the DRIVER (executor environments don't
+        # inherit the driver's env) and ride the task closure.
+        from sparkdl_tpu.observability.health import preflight_env_opts
+
+        preflight_opts = preflight_env_opts()
 
         def barrier_task(it):
             from pyspark import BarrierTaskContext
@@ -209,6 +214,12 @@ class SparkBarrierBackend:
                 num_processes=nprocs,
                 process_id=rank,
             )
+            # Slice health probe before the user fn compiles anything: a bad
+            # chip fails this barrier task now, and Spark's stage retry plus
+            # checkpoint resume (sparkdl_tpu.checkpoint) handle the rest.
+            from sparkdl_tpu.observability.health import preflight
+
+            preflight(rank=rank, **preflight_opts)
             p = cloudpickle.loads(payload)
             out = p["fn"](**p["kwargs"])
             yield pickle.dumps(out) if rank == 0 else b""

@@ -33,7 +33,9 @@ def prefetch_to_device(
     """
     if transfer is None:
         transfer = jax.device_put
-    q: queue.Queue = queue.Queue(maxsize=size)
+    # maxsize=0 would make the queue unbounded (prefetch the whole stream);
+    # clamp so size<=0 means minimal, not infinite, buffering.
+    q: queue.Queue = queue.Queue(maxsize=max(1, size))
     err: list[BaseException] = []
     stop = threading.Event()
 

@@ -79,12 +79,20 @@ def finetune_classifier(
     weight_decay: float = 0.01,
     mesh: Mesh | None = None,
     metrics_cb: Callable[[dict], None] | None = None,
+    checkpoint_dir: "str | None" = None,
+    checkpoint_every: int = 100,
+    keep_checkpoints: int = 3,
 ) -> tuple[Any, list[dict]]:
     """Run the fine-tune loop over ``batches``; returns (params, history).
 
     Each batch dict's arrays are placed batch-sharded over the mesh's data
     axes before the jitted step — under TPURunner each process feeds its
     local shard of the global batch.
+
+    With ``checkpoint_dir`` set, the full train state is async-saved every
+    ``checkpoint_every`` steps plus once at the end, and an existing
+    checkpoint in that directory is resumed from (already-trained steps are
+    skipped) — the barrier-retry resume story from SURVEY.md §5.
     """
     if mesh is None:
         mesh = data_parallel_mesh()
@@ -93,27 +101,56 @@ def finetune_classifier(
 
     data_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
     repl = NamedSharding(mesh, P())
-    with jax.set_mesh(mesh):
-        state = TrainState(
-            params=jax.device_put(params, repl),
-            opt_state=jax.device_put(tx.init(params), repl),
-            step=jnp.zeros((), jnp.int32),
+    ckpt = None
+    if checkpoint_dir is not None:
+        from sparkdl_tpu.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(
+            checkpoint_dir, keep=keep_checkpoints,
+            save_interval_steps=checkpoint_every,
         )
-        history: list[dict] = []
-        for batch in batches:
-            batch = {
-                k: jax.device_put(jnp.asarray(v), data_sharding)
-                for k, v in batch.items()
-            }
-            t0 = time.perf_counter()
-            state, metrics = step(state, batch)
-            metrics = {k: float(v) for k, v in metrics.items()}
-            metrics["step_time_s"] = time.perf_counter() - t0
-            metrics["step"] = int(state.step)
-            history.append(metrics)
-            if metrics_cb is not None:
-                metrics_cb(metrics)
-    return state.params, history
+    try:
+        with jax.set_mesh(mesh):
+            state = TrainState(
+                params=jax.device_put(params, repl),
+                opt_state=jax.device_put(tx.init(params), repl),
+                step=jnp.zeros((), jnp.int32),
+            )
+            resume_step = 0
+            if ckpt is not None and ckpt.latest_step() is not None:
+                state = ckpt.restore(template=state)
+                resume_step = int(state.step)
+            history: list[dict] = []
+            last_saved = resume_step
+            for i, batch in enumerate(batches):
+                if i < resume_step:  # deterministic iterator replay on resume
+                    continue
+                batch = {
+                    k: jax.device_put(jnp.asarray(v), data_sharding)
+                    for k, v in batch.items()
+                }
+                t0 = time.perf_counter()
+                state, metrics = step(state, batch)
+                metrics = {k: float(v) for k, v in metrics.items()}
+                metrics["step_time_s"] = time.perf_counter() - t0
+                metrics["step"] = int(state.step)
+                history.append(metrics)
+                if metrics_cb is not None:
+                    metrics_cb(metrics)
+                if ckpt is not None:
+                    if ckpt.save(int(state.step), state):
+                        last_saved = int(state.step)
+            if (
+                ckpt is not None
+                and int(state.step) > resume_step
+                and last_saved != int(state.step)
+            ):
+                # final state always lands regardless of the interval policy
+                ckpt.save(int(state.step), state, force=True)
+            return state.params, history
+    finally:
+        if ckpt is not None:
+            ckpt.close()
 
 
 def batches_from_arrays(

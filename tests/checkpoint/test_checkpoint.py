@@ -1,0 +1,142 @@
+"""Checkpoint/resume tests.
+
+Reference has no framework checkpointing (SURVEY.md §5 — user-level only);
+these tests pin the TPU build's upgrade: async sharded save of the full
+train state, restore back onto the same mesh shardings, and
+interrupt-then-resume equivalence of the fine-tune loop.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sparkdl_tpu.checkpoint import CheckpointManager, restore_matching
+from sparkdl_tpu.runtime.mesh import data_parallel_mesh
+
+
+def _state(mesh, scale=1.0):
+    shard = NamedSharding(mesh, P(("dp", "fsdp")))
+    repl = NamedSharding(mesh, P())
+    return {
+        "params": {
+            "w": jax.device_put(
+                jnp.arange(16.0, dtype=jnp.float32).reshape(8, 2) * scale,
+                shard,
+            ),
+            "b": jax.device_put(jnp.full((2,), 0.5 * scale), repl),
+        },
+        "step": jax.device_put(jnp.asarray(7, jnp.int32), repl),
+    }
+
+
+class TestCheckpointManager:
+    def test_save_restore_roundtrip_preserves_values_and_sharding(
+        self, tmp_path, eight_device_mesh
+    ):
+        mesh = eight_device_mesh
+        state = _state(mesh)
+        with CheckpointManager(tmp_path / "ckpt") as mgr:
+            assert mgr.save(7, state)
+            mgr.wait()
+            assert mgr.latest_step() == 7
+            fresh = _state(mesh, scale=0.0)  # template: shapes + shardings
+            restored = mgr.restore(template=fresh)
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["w"]), np.asarray(state["params"]["w"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["b"]), np.asarray(state["params"]["b"])
+        )
+        assert int(restored["step"]) == 7
+        # restored leaves carry the template's sharding (distributed resume)
+        assert restored["params"]["w"].sharding.is_equivalent_to(
+            state["params"]["w"].sharding, ndim=2
+        )
+
+    def test_keep_bounds_retained_steps(self, tmp_path, eight_device_mesh):
+        state = _state(eight_device_mesh)
+        with CheckpointManager(tmp_path / "ckpt", keep=2) as mgr:
+            for s in (1, 2, 3, 4):
+                mgr.save(s, state, force=True)
+                mgr.wait()
+            assert mgr.latest_step() == 4
+            assert len(mgr.all_steps()) <= 2
+
+    def test_save_interval_policy_skips_and_force_overrides(
+        self, tmp_path, eight_device_mesh
+    ):
+        state = _state(eight_device_mesh)
+        with CheckpointManager(
+            tmp_path / "ckpt", save_interval_steps=10
+        ) as mgr:
+            assert mgr.save(0, state)
+            assert not mgr.save(3, state)      # inside the interval: skipped
+            assert mgr.save(3, state, force=True)
+            mgr.wait()
+            assert 3 in mgr.all_steps()
+
+    def test_restore_missing_raises(self, tmp_path, eight_device_mesh):
+        with CheckpointManager(tmp_path / "none") as mgr:
+            with pytest.raises(FileNotFoundError):
+                mgr.restore(template={"x": jnp.zeros(1)})
+
+    def test_one_shot_restore_matching(self, tmp_path, eight_device_mesh):
+        state = _state(eight_device_mesh)
+        with CheckpointManager(tmp_path / "c") as mgr:
+            mgr.save(1, state, force=True)
+        got = restore_matching(tmp_path / "c", _state(eight_device_mesh, 0.0))
+        np.testing.assert_array_equal(
+            np.asarray(got["params"]["w"]), np.asarray(state["params"]["w"])
+        )
+
+
+class TestFinetuneResume:
+    """Interrupt-and-resume of the training loop reproduces the
+    uninterrupted run (SURVEY.md §5: barrier retry -> restart from
+    checkpoint, deterministic replay skips done steps)."""
+
+    def _data(self, n=8, b=8, d=4):
+        rng = np.random.default_rng(0)
+        xs = rng.normal(size=(n, b, d)).astype(np.float32)
+        ys = (rng.random(n * b).reshape(n, b) > 0.5).astype(np.int32)
+        return [{"x": xs[i], "labels": ys[i]} for i in range(n)]
+
+    def _apply(self, params, x):
+        return x @ params["w"] + params["b"]
+
+    def _params(self, d=4):
+        rng = jax.random.PRNGKey(1)
+        return {
+            "w": jax.random.normal(rng, (d, 2)) * 0.1,
+            "b": jnp.zeros((2,)),
+        }
+
+    def test_resume_matches_uninterrupted(self, tmp_path, eight_device_mesh):
+        from sparkdl_tpu.train.finetune import finetune_classifier
+
+        batches = self._data()
+        ref_params, ref_hist = finetune_classifier(
+            self._apply, self._params(), batches, learning_rate=1e-2
+        )
+        assert len(ref_hist) == len(batches)
+
+        ckdir = str(tmp_path / "resume")
+        # phase 1: first half, checkpoint every step
+        finetune_classifier(
+            self._apply, self._params(), batches[: len(batches) // 2],
+            learning_rate=1e-2, checkpoint_dir=ckdir, checkpoint_every=1,
+        )
+        # phase 2: full iterator again — resumes, replays only the tail
+        got_params, hist2 = finetune_classifier(
+            self._apply, self._params(), batches,
+            learning_rate=1e-2, checkpoint_dir=ckdir, checkpoint_every=1,
+        )
+        assert len(hist2) == len(batches) - len(batches) // 2
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            got_params, ref_params,
+        )

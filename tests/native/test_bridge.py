@@ -164,3 +164,34 @@ def test_feeder_overlap_smoke():
         time.sleep(0.005)  # slow consumer
         got.append(float(np.asarray(arr)[0]))
     assert got == [float(i) for i in range(10)]
+
+
+def test_pack_rows_pad_only_c_call_zero_fills():
+    """Direct C-ABI pad-only call (n_rows=0, pad_rows>0): must zero-fill,
+    not read the empty srcs array (the Python wrapper rejects empty rows,
+    but the exported symbol has its own contract)."""
+    import ctypes
+
+    l = _lib.lib()
+    if l is None:
+        pytest.skip("native library unavailable")
+    stride, pad = 16, 4
+    dst = np.full(pad * stride, 0xAB, np.uint8)
+    l.sdl_pack_rows(
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        None, None, 0, pad, 0, stride, 2,
+    )
+    assert not dst.any()
+
+
+def test_device_feeder_single_slot_python_fallback_bounded(monkeypatch):
+    """n_slots=1 on the fallback path must keep the prefetch queue bounded
+    (maxsize>=1), not unbounded (maxsize=0)."""
+    monkeypatch.setattr(
+        "sparkdl_tpu.native.bridge.native_available", lambda: False
+    )
+    batches = [np.full((4,), i, np.float32) for i in range(6)]
+    feeder = DeviceFeeder(iter(batches), n_slots=1)
+    got = [np.asarray(b) for b in feeder]
+    assert len(got) == 6
+    np.testing.assert_array_equal(got[3], batches[3])
