@@ -47,6 +47,8 @@ class BertConfig:
     hidden_dropout_prob: float = 0.1
     attention_probs_dropout_prob: float = 0.1
     #: "full" = plain softmax attention (padding-masked);
+    #: "flash" = fused Pallas flash-attention kernel (ops.flash_attention)
+    #: — the TPU hot path: scores never materialised in HBM;
     #: "ring" = sp-sharded exact ring attention (call under shard_map with
     #: the sequence dim split on ``sp_axis``).
     attn_impl: str = "full"
@@ -103,19 +105,27 @@ class BertSelfAttention(nn.Module):
         b, l = x.shape[0], x.shape[1]
         q, k, v = (t.reshape(b, l, nh, hd) for t in (q, k, v))
 
-        if c.attn_impl == "ring":
+        if c.attn_impl in ("ring", "flash"):
             if train and c.attention_probs_dropout_prob > 0:
                 # Blockwise accumulation never materialises the probability
                 # matrix, so attention-probs dropout cannot be applied on
-                # the ring path (the usual flash-attention trade-off).
+                # the ring/flash paths (the usual flash-attention trade-off).
                 import warnings
 
                 warnings.warn(
-                    "attn_impl='ring' skips attention-probs dropout "
-                    f"(p={c.attention_probs_dropout_prob}); set "
+                    f"attn_impl={c.attn_impl!r} skips attention-probs "
+                    f"dropout (p={c.attention_probs_dropout_prob}); set "
                     "attention_probs_dropout_prob=0 to silence",
                     stacklevel=2,
                 )
+        if c.attn_impl == "flash":
+            from sparkdl_tpu.ops.flash_attention import flash_attention
+
+            ctx = flash_attention(
+                q, k, v,
+                kv_mask=None if attention_mask is None else attention_mask,
+            )
+        elif c.attn_impl == "ring":
             ctx = ring_self_attention(
                 q, k, v,
                 kv_mask=None if attention_mask is None else attention_mask,

@@ -100,11 +100,16 @@ def lib() -> ctypes.CDLL | None:
             logger.info("native bridge disabled via SPARKDL_TPU_DISABLE_NATIVE")
             return None
         # Rebuild when the cached .so predates the source (git pull with a
-        # persisting _build/), not only when it is absent.
-        stale = (
-            os.path.exists(_SO)
-            and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
-        )
+        # persisting _build/), not only when it is absent. A deployment may
+        # ship the prebuilt .so without csrc/ — a missing source is simply
+        # "not stale", never an error.
+        try:
+            stale = (
+                os.path.exists(_SO)
+                and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            )
+        except OSError:
+            stale = False
         if (not os.path.exists(_SO) or stale) and not _compile():
             if not os.path.exists(_SO):
                 return None  # no cached build to fall back to

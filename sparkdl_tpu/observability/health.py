@@ -94,17 +94,28 @@ def check_health(*, collective: bool = True,
             collective_ok = True
         except Exception as e:
             error = error or f"{type(e).__name__}: {e}"
+    # A wedged backend can make every one of these probes raise (the exact
+    # case this report exists to describe) — the "raises nothing" contract
+    # means each gets an independent fallback.
     try:
         n_global = jax.device_count()
     except Exception:
         n_global = 0
+    try:
+        proc_idx, proc_cnt = jax.process_index(), jax.process_count()
+    except Exception:
+        proc_idx, proc_cnt = -1, 0
+    try:
+        platform = jax.default_backend()
+    except Exception:
+        platform = "unknown"
     return HealthReport(
         ok=error is None,
         n_local_devices=len(local),
         n_global_devices=n_global,
-        process_index=jax.process_index(),
-        process_count=jax.process_count(),
-        platform=jax.default_backend(),
+        process_index=proc_idx,
+        process_count=proc_cnt,
+        platform=platform,
         device_kinds=sorted({d.device_kind for d in local}),
         probe_time_s=time.perf_counter() - t0,
         collective_ok=collective_ok,

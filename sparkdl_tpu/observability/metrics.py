@@ -199,8 +199,19 @@ def aggregate_across_hosts(metrics: dict[str, float | None]) -> dict:
     """
     import numpy as np
 
-    keys = sorted(k for k, v in metrics.items() if isinstance(v, (int, float)))
-    local = np.asarray([float(metrics[k]) for k in keys], np.float64)
+    # Key set must be identical on every host or the allgather misaligns
+    # (a straggler host with None metrics would otherwise ship fewer
+    # columns) — so keep ALL keys and encode missing values as NaN, then
+    # reduce with the nan-aware ops.
+    keys = sorted(metrics.keys())
+    local = np.asarray(
+        [
+            float(metrics[k])
+            if isinstance(metrics[k], (int, float)) else np.nan
+            for k in keys
+        ],
+        np.float64,
+    )
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -210,6 +221,9 @@ def aggregate_across_hosts(metrics: dict[str, float | None]) -> dict:
     out: dict[str, dict[str, float]] = {}
     for i, k in enumerate(keys):
         col = stacked[:, i]
+        col = col[~np.isnan(col)]
+        if col.size == 0:
+            continue
         out[k] = {
             "mean": float(col.mean()),
             "min": float(col.min()),

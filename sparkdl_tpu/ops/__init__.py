@@ -1,3 +1,4 @@
+from sparkdl_tpu.ops.flash_attention import flash_attention
 from sparkdl_tpu.ops.preprocess import (
     PREPROCESSORS,
     preprocess_caffe,
@@ -9,6 +10,7 @@ from sparkdl_tpu.ops.preprocess import (
 
 __all__ = [
     "PREPROCESSORS",
+    "flash_attention",
     "preprocess_caffe",
     "preprocess_identity",
     "preprocess_tf",
