@@ -15,6 +15,8 @@ library but XLA programs over ICI — this package owns the idiomatic forms:
   layers with the ``psum`` placed exactly once per block.
 - :mod:`pipeline` — collective-permute pipeline parallelism over the ``pp``
   axis (GPipe schedule via ``lax.scan``).
+- :mod:`expert_parallel` — GShard/Switch-style MoE over the ``ep`` axis
+  (token-choice routing, capacity masks, GSPMD all-to-all dispatch).
 
 Axis names are the canonical ones from ``sparkdl_tpu.runtime.mesh``.
 """
@@ -32,6 +34,11 @@ from sparkdl_tpu.parallel.tensor_parallel import (
     TPMlpBlock,
 )
 from sparkdl_tpu.parallel.pipeline import pipeline_apply
+from sparkdl_tpu.parallel.expert_parallel import (
+    MoEMlpBlock,
+    moe_aux_losses,
+    top_k_dispatch,
+)
 
 __all__ = [
     "all_gather_params",
@@ -44,4 +51,7 @@ __all__ = [
     "RowParallelDense",
     "TPMlpBlock",
     "pipeline_apply",
+    "MoEMlpBlock",
+    "moe_aux_losses",
+    "top_k_dispatch",
 ]

@@ -1,0 +1,200 @@
+"""Expert parallelism: Mixture-of-Experts layers sharded over ``ep``.
+
+The reference has no expert parallelism of any kind (SURVEY.md 2.11 — its
+only parallelism is DP); this module exists because a TPU-pod framework
+needs the fourth classic axis alongside tp/pp/sp. The design is the
+GShard/Switch token-choice form, expressed the idiomatic TPU way:
+
+- Expert weights live as single arrays with a leading expert dim,
+  annotated ``(ep, ...)`` via ``nn.with_partitioning`` — one expert (or a
+  contiguous group of experts) per ``ep`` peer.
+- Routing produces dense dispatch/combine tensors (static shapes, capacity
+  bounded) and token->expert movement is two einsums. When the token batch
+  is dp-sharded and the expert dim ep-sharded, GSPMD lowers those einsums
+  to ICI **all-to-alls** — the hand-written `alltoall` of GPU MoE stacks
+  is compiler-inserted here, never written by hand.
+- Everything is static-shape: top-k selection and capacity overflow are
+  masks, not gathers with data-dependent sizes, so the whole layer jits
+  and differentiates cleanly (overflowed tokens contribute zero and fall
+  through the residual connection).
+
+Aux losses follow Switch Transformer: a load-balancing loss (sowed under
+``intermediates/aux_loss``) pushes the router toward uniform expert usage,
+and router z-loss (``intermediates/router_z_loss``) keeps logits bounded.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from sparkdl_tpu.parallel.tensor_parallel import constrain_dim
+
+Dtype = Any
+
+
+def _constrain_leading(x: jax.Array, axis: str) -> jax.Array:
+    """Constrain dim 0 (the expert dim) to ``axis``; the rest stays
+    UNCONSTRAINED (shared contract: tensor_parallel.constrain_dim)."""
+    return constrain_dim(x, axis, dim=0)
+
+
+def top_k_dispatch(
+    gates: jax.Array, k: int, capacity: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Token-choice top-k assignment with per-expert capacity.
+
+    gates: [G, S, E] router probabilities (softmax output, f32).
+    Returns (combine, dispatch, aux_loss):
+      combine  [G, S, E, C] f32 — gate weight of token s in expert e's
+               capacity slot c (zero if unrouted/overflowed),
+      dispatch [G, S, E, C] bool — combine > 0,
+      aux_loss scalar f32 — Switch load-balancing loss (1.0 = perfectly
+               balanced, grows as routing collapses onto few experts).
+
+    Tokens pick experts greedily (slot 0 = argmax, slot 1 = second
+    choice, ...); positions within an expert's capacity go in token order
+    (cumsum), tokens past capacity are dropped for that slot. All shapes
+    static; everything differentiable w.r.t. ``gates`` through ``combine``.
+    """
+    g, s, e = gates.shape
+    if k > e:
+        raise ValueError(f"k={k} exceeds num_experts={e}")
+    combine = jnp.zeros((g, s, e, capacity), jnp.float32)
+    counts = jnp.zeros((g, e), jnp.float32)  # tokens routed per expert so far
+    masked = gates
+    first_choice = None
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)  # [G, S]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [G, S, E]
+        if first_choice is None:
+            first_choice = onehot
+        # Position of each token inside its chosen expert's buffer.
+        pos = jnp.cumsum(onehot, axis=1) - onehot + counts[:, None, :]
+        pos_tok = jnp.sum(pos * onehot, axis=-1)  # [G, S]
+        within = (pos_tok < capacity).astype(jnp.float32)
+        gate_val = jnp.sum(gates * onehot, axis=-1)  # [G, S]
+        cap_onehot = jax.nn.one_hot(
+            pos_tok.astype(jnp.int32), capacity, dtype=jnp.float32
+        )  # [G, S, C]
+        combine = combine + (
+            (gate_val * within)[:, :, None, None]
+            * onehot[:, :, :, None]
+            * cap_onehot[:, :, None, :]
+        )
+        counts = counts + jnp.sum(onehot, axis=1)
+        masked = masked * (1.0 - onehot)  # exclude chosen expert next slot
+
+    dispatch = combine > 0.0
+    # Switch aux loss: E * <fraction routed to e (slot 0)> . <mean gate of e>
+    density = jnp.mean(first_choice, axis=1)  # [G, E]
+    density_proxy = jnp.mean(gates, axis=1)  # [G, E]
+    aux_loss = jnp.mean(density * density_proxy) * (e**2)
+    return combine, dispatch, aux_loss
+
+
+class MoEMlpBlock(nn.Module):
+    """Mixture-of-experts MLP: router -> top-k dispatch -> per-expert
+    up/act/down -> weighted combine.
+
+    Drop-in for a dense MLP block on [..., S, M] activations (2-D [N, M]
+    input is treated as one group). Expert weights are stacked on a leading
+    expert dim annotated with ``ep_axis`` — initialise with
+    ``tensor_parallel.init_sharded`` to place them. Inside a dp x ep mesh
+    the dispatch/combine einsums become ICI all-to-alls (see module doc).
+
+    ``capacity_factor`` bounds per-expert work: capacity =
+    ceil(S * k / E * capacity_factor) (>= 1 row per expert). Overflowed
+    tokens get zero output for that slot — pair with a residual connection.
+    """
+
+    num_experts: int
+    hidden_features: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    ep_axis: str = "ep"
+    activation: Callable = nn.gelu
+    dtype: Dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[None]  # [1, N, M]
+        lead = x.shape[:-2]
+        g = math.prod(lead) if lead else 1
+        s, m = x.shape[-2], x.shape[-1]
+        tokens = x.reshape(g, s, m)
+
+        # Router in f32 (logit stability), replicated weights.
+        logits = nn.Dense(
+            self.num_experts,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=self.kernel_init,
+            name="router",
+        )(tokens.astype(jnp.float32))
+        gates = jax.nn.softmax(logits, axis=-1)
+
+        capacity = max(
+            1, math.ceil(s * self.k / self.num_experts * self.capacity_factor)
+        )
+        combine, dispatch, aux = top_k_dispatch(gates, self.k, capacity)
+        self.sow("intermediates", "aux_loss", aux)
+        self.sow(
+            "intermediates",
+            "router_z_loss",
+            jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2),
+        )
+
+        wi = self.param(
+            "wi",
+            nn.with_partitioning(self.kernel_init, (self.ep_axis, None, None)),
+            (self.num_experts, m, self.hidden_features),
+            self.dtype,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_partitioning(self.kernel_init, (self.ep_axis, None, None)),
+            (self.num_experts, self.hidden_features, m),
+            self.dtype,
+        )
+
+        # dispatch: tokens -> [E, G, C, M] expert buffers (all-to-all under
+        # GSPMD when tokens are dp-sharded and E is ep-sharded).
+        expert_in = jnp.einsum(
+            "gsec,gsm->egcm", dispatch.astype(self.dtype), tokens.astype(self.dtype)
+        )
+        expert_in = _constrain_leading(expert_in, self.ep_axis)
+        h = self.activation(jnp.einsum("egcm,emh->egch", expert_in, wi))
+        expert_out = jnp.einsum("egch,ehm->egcm", h, wo)
+        expert_out = _constrain_leading(expert_out, self.ep_axis)
+        # combine: expert buffers -> tokens, weighted by the gate values.
+        y = jnp.einsum(
+            "gsec,egcm->gsm", combine.astype(self.dtype), expert_out
+        )
+
+        y = y.reshape(x.shape)
+        return y[0] if squeeze else y
+
+
+def moe_aux_losses(intermediates: Any) -> dict[str, jax.Array]:
+    """Sum every sowed MoE aux/z loss in an ``intermediates`` collection.
+
+    Use: ``(y, inters) = model.apply(vars, x, mutable=['intermediates'])``
+    then add ``alpha * losses['aux_loss'] + beta * losses['router_z_loss']``
+    to the task loss.
+    """
+    out = {"aux_loss": jnp.zeros(()), "router_z_loss": jnp.zeros(())}
+    flat = jax.tree_util.tree_flatten_with_path(intermediates)[0]
+    for path, leaf in flat:
+        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        for key in out:
+            if key in names:
+                out[key] = out[key] + jnp.sum(leaf)
+    return out

@@ -33,24 +33,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 Dtype = Any
 
 
-def _constrain(x: jax.Array, axis: str) -> jax.Array:
-    """Constrain the trailing (feature) dim to ``axis``; leading dims stay
-    UNCONSTRAINED so GSPMD keeps whatever batch/sequence sharding is in
-    flight. No-op outside a mesh context (single-device tests); a mesh
-    without the axis is a real error and propagates."""
+def constrain_dim(x: jax.Array, axis: str, dim: int = -1) -> jax.Array:
+    """Constrain one dim of ``x`` to ``axis``; the others stay UNCONSTRAINED
+    so GSPMD keeps whatever batch/sequence sharding is in flight. ``dim=-1``
+    is the tp feature-dim form; expert_parallel uses ``dim=0`` for the
+    leading expert dim. No-op outside a mesh context (single-device tests)
+    or under shard_map over the axis (arrays are already per-device blocks);
+    a mesh without the axis is a real error and propagates."""
     mesh = jax.sharding.get_abstract_mesh()
     if mesh.empty:
         return x
     if axis not in mesh.axis_names:
         raise ValueError(
-            f"tp_axis {axis!r} not in the active mesh axes {mesh.axis_names}"
+            f"axis {axis!r} not in the active mesh axes {mesh.axis_names}"
         )
     if axis in getattr(mesh, "manual_axes", ()):
-        # Inside shard_map over this axis: arrays are already per-device
-        # blocks, there is nothing for GSPMD to constrain.
         return x
-    spec = P(*([P.UNCONSTRAINED] * (x.ndim - 1)), axis)
-    return lax.with_sharding_constraint(x, spec)
+    parts: list = [P.UNCONSTRAINED] * x.ndim
+    parts[dim] = axis
+    return lax.with_sharding_constraint(x, P(*parts))
 
 
 class ColumnParallelDense(nn.Module):
@@ -79,7 +80,7 @@ class ColumnParallelDense(nn.Module):
                 self.dtype,
             )
             y = y + bias
-        return _constrain(y, self.tp_axis)
+        return constrain_dim(y, self.tp_axis)
 
 
 class RowParallelDense(nn.Module):
