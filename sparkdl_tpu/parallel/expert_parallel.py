@@ -61,8 +61,8 @@ def top_k_dispatch(
     static; everything differentiable w.r.t. ``gates`` through ``combine``.
     """
     g, s, e = gates.shape
-    if k > e:
-        raise ValueError(f"k={k} exceeds num_experts={e}")
+    if not 1 <= k <= e:
+        raise ValueError(f"k={k} must be in [1, num_experts={e}]")
     combine = jnp.zeros((g, s, e, capacity), jnp.float32)
     counts = jnp.zeros((g, e), jnp.float32)  # tokens routed per expert so far
     masked = gates
@@ -76,7 +76,11 @@ def top_k_dispatch(
         pos = jnp.cumsum(onehot, axis=1) - onehot + counts[:, None, :]
         pos_tok = jnp.sum(pos * onehot, axis=-1)  # [G, S]
         within = (pos_tok < capacity).astype(jnp.float32)
-        gate_val = jnp.sum(gates * onehot, axis=-1)  # [G, S]
+        # Gate weight from the *masked* gates: identical to the original
+        # value for a live pick, but exactly zero when a token's remaining
+        # gates have all underflowed to 0 (argmax of an all-zero row says
+        # expert 0; reading the unmasked gate would double-count it).
+        gate_val = jnp.sum(masked * onehot, axis=-1)  # [G, S]
         cap_onehot = jax.nn.one_hot(
             pos_tok.astype(jnp.int32), capacity, dtype=jnp.float32
         )  # [G, S, C]

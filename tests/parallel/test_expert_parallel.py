@@ -51,11 +51,25 @@ class TestTopKDispatch:
         assert routed > 0
         assert np.all(np.isfinite(np.asarray(combine)))
 
-    def test_k_exceeding_experts_rejected(self):
+    def test_k_out_of_range_rejected(self):
         import pytest
 
-        with pytest.raises(ValueError, match="exceeds num_experts"):
+        with pytest.raises(ValueError, match="must be in"):
             top_k_dispatch(_gates(e=2), k=3, capacity=8)
+        with pytest.raises(ValueError, match="must be in"):
+            top_k_dispatch(_gates(e=2), k=0, capacity=8)
+
+    def test_underflowed_gates_not_double_counted(self):
+        # Token whose 3rd-choice gate underflowed to exactly 0: argmax of
+        # the all-zero remainder points at expert 0 again — it must NOT be
+        # re-dispatched there with its full original weight.
+        gates = jnp.asarray([[[0.6, 0.4, 0.0, 0.0]]], jnp.float32)
+        combine, dispatch, _ = top_k_dispatch(gates, k=3, capacity=4)
+        np.testing.assert_allclose(
+            float(jnp.sum(combine)), 1.0, rtol=1e-6
+        )
+        # Expert 0 holds the token exactly once.
+        assert int(jnp.sum(dispatch[0, 0, 0])) == 1
 
     def test_aux_loss_is_one_when_balanced(self):
         g, s, e = 2, 32, 4
