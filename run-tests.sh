@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Test runner (reference parity: [U: python/run-tests.sh], SURVEY.md 2.22).
+# Runs the suite on a virtual 8-device CPU mesh (conftest.py forces
+# JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count=8) so every
+# dp/tp/sp/ep/pp collective path executes without TPU hardware.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+python -m pytest tests/ -q "$@"
+
+# Driver-contract smoke: bench prints exactly one JSON line; graft hooks
+# compile entry() and run the 5-regime multichip dryrun.
+JAX_PLATFORMS=cpu BENCH_STEPS=2 BENCH_BATCH=4 python bench.py | tail -1 | python -c '
+import json, sys
+line = sys.stdin.readline()
+rec = json.loads(line)
+assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
+print("bench.py contract OK")
+'
+python __graft_entry__.py
