@@ -1,0 +1,68 @@
+"""registerKerasImageUDF batch scoring (BASELINE.json config 3).
+
+Registers a Keras image model as a scoring UDF and applies it to image
+rows — the reference's ``SELECT my_udf(image) FROM t`` deployment path.
+With a pyspark session the UDF also registers for Spark SQL; standalone,
+the returned callable scores image structs directly (the same composed
+struct-decode -> preprocess -> model XLA program either way).
+
+Uses a small CNN by default so it runs in seconds; pass --resnet50 for
+the reference's ResNet50 scoring workload (random-init weights when
+pretrained downloads are unavailable).
+
+Run: python examples/sql_udf_scoring.py [--resnet50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def main() -> None:
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--resnet50", action="store_true")
+    args = ap.parse_args()
+
+    import keras
+
+    if args.resnet50:
+        try:
+            model = keras.applications.ResNet50(weights="imagenet")
+        except Exception:
+            print("pretrained download unavailable; using random init")
+            model = keras.applications.ResNet50(weights=None)
+    else:
+        model = keras.Sequential(
+            [
+                keras.layers.Input(shape=(32, 32, 3)),
+                keras.layers.Conv2D(8, 3, activation="relu"),
+                keras.layers.GlobalAveragePooling2D(),
+                keras.layers.Dense(10, activation="softmax"),
+            ]
+        )
+
+    from sparkdl_tpu import registerKerasImageUDF
+    from sparkdl_tpu.image import imageIO
+
+    score = registerKerasImageUDF("score_image", model)
+
+    rng = np.random.default_rng(0)
+    side = model.input_shape[1] or 224
+    structs = [
+        imageIO.imageArrayToStruct(
+            rng.integers(0, 255, (side, side, 3)).astype(np.uint8),
+            origin=f"mem://{i}",
+        )
+        for i in range(16)
+    ]
+    preds = np.stack([np.asarray(score(s)) for s in structs])
+    print(f"scored {preds.shape[0]} images -> {preds.shape[1]} classes "
+          f"(udf 'score_image'); row sums ~1: {preds.sum(1)[:3]}")
+
+
+if __name__ == "__main__":
+    main()

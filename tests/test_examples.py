@@ -1,0 +1,51 @@
+"""Every example script runs end-to-end (tiny settings).
+
+The examples double as living documentation for the five BASELINE.json
+benchmark configs; a broken example is a broken quickstart.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run(script: str, *args: str) -> str:
+    env = dict(os.environ)
+    env.setdefault("KERAS_BACKEND", "jax")
+    out = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), *args],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert out.returncode == 0, f"{script} failed:\n{out.stdout}\n{out.stderr}"
+    return out.stdout
+
+
+def test_transfer_learning_flowers():
+    out = _run("transfer_learning_flowers.py", "--steps", "50")
+    assert "train accuracy" in out
+
+
+def test_keras_tabular_inference():
+    out = _run("keras_tabular_inference.py")
+    assert "matches model.predict" in out
+
+
+def test_sql_udf_scoring():
+    out = _run("sql_udf_scoring.py")
+    assert "udf 'score_image'" in out
+
+
+@pytest.mark.slow
+def test_distributed_resnet_training():
+    out = _run("distributed_resnet_training.py", "--steps", "2")
+    assert "4 devices across 2 processes" in out
+
+
+@pytest.mark.slow
+def test_bert_finetune_hpo():
+    out = _run("bert_finetune_hpo.py", "--evals", "2", "--epochs", "1")
+    assert "best params" in out
