@@ -1,0 +1,107 @@
+"""Secondary benchmark: ResNet50 training MFU (BASELINE.md north-star 2).
+
+Prints one JSON line like bench.py (the driver contract runs bench.py; this
+script is the training-side evidence). Measures the steady-state jitted
+train step — bf16 ResNet50, SGD+momentum, device-resident batch — and
+reports MFU via the framework's own StepMeter/compiled_flops meters
+(observability.metrics), against the >=50% target from BASELINE.md.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+    import optax
+
+    from sparkdl_tpu.models.resnet import ResNet50
+    from sparkdl_tpu.observability.metrics import StepMeter, compiled_flops
+
+    platform = jax.default_backend()
+    on_accel = platform not in ("cpu",)
+    batch = int(os.environ.get("BENCH_BATCH", 256 if on_accel else 8))
+    steps = int(os.environ.get("BENCH_STEPS", 30 if on_accel else 3))
+    size = 224 if on_accel else 32
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
+
+    model = ResNet50(num_classes=1000, include_top=True, dtype=dtype)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3))
+    )
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(params, batch_stats, x, y):
+        (_, probs), updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            x, train=True, mutable=["batch_stats"],
+        )
+        logp = jnp.log(jnp.clip(probs, 1e-8))
+        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+        return loss, updates["batch_stats"]
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_step(params, batch_stats, opt_state, x, y):
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch_stats, x, y
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), stats, opt_state, loss
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.random((batch, size, size, 3), np.float32))
+    y = jax.device_put(rng.integers(0, 1000, batch).astype(np.int32))
+
+    flops_per_step = compiled_flops(
+        train_step, params, batch_stats, opt_state, x, y
+    )
+    meter = StepMeter(flops_per_step=flops_per_step, n_chips=1)
+
+    # warmup / compile; the forced scalar read (not block_until_ready, whose
+    # readiness signal is unreliable for large output trees on relayed
+    # backends) drains the queue before timing starts.
+    params, batch_stats, opt_state, loss = train_step(
+        params, batch_stats, opt_state, x, y
+    )
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, x, y
+        )
+    float(loss)  # forced read: the dependency chain pins all steps behind it
+    step_time = (time.perf_counter() - t0) / steps
+    for _ in range(steps):
+        meter.record(step_time, examples=batch)
+
+    s = meter.summary()
+    mfu = s.get("mfu")
+    target = 0.50
+    print(
+        json.dumps(
+            {
+                "metric": f"ResNet50 train MFU ({platform}, {size}px, "
+                          f"batch {batch})",
+                "value": round(mfu, 4) if mfu is not None else None,
+                "unit": "MFU",
+                "vs_baseline": round(mfu / target, 4) if mfu else None,
+                "examples_per_sec_per_chip": s.get("examples_per_sec_per_chip"),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
