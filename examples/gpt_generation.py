@@ -1,0 +1,68 @@
+"""Decoder LM training step + KV-cached generation (beyond-parity demo).
+
+Trains a tiny GPT for a few steps on a synthetic copy task (re-emit the
+current token), then generates greedily with the KV cache — the whole
+decode loop is one jitted ``lax.scan``, no Python-level round trips. Swap
+in a bigger ``GPTConfig`` (attn_impl='flash', num_experts>0 for MoE) on
+TPU; the same code paths scale.
+
+Run: python examples/gpt_generation.py [--steps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel, generate
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    args = ap.parse_args()
+
+    cfg = GPTConfig.tiny(vocab_size=32, max_seq_len=32)
+    model = GPTLMHeadModel(cfg)
+    rng = np.random.default_rng(0)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    tx = optax.adamw(3e-3)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, ids):
+        logits, _ = model.apply(p, ids)
+        logp = jax.nn.log_softmax(logits[:, :-1])
+        tgt = ids[:, :-1]  # copy task: predict the CURRENT token again
+        return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None], -1))
+
+    @jax.jit
+    def train_step(p, o, ids):
+        l, g = jax.value_and_grad(loss_fn)(p, ids)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, l
+
+    for i in range(args.steps):
+        ids = jnp.asarray(rng.integers(0, 32, (16, 16)), jnp.int32)
+        params, opt_state, loss = train_step(params, opt_state, ids)
+        if i % 20 == 0:
+            print(f"step {i}: loss {float(loss):.4f}")
+
+    prompt = jnp.asarray(rng.integers(0, 32, (2, 4)), jnp.int32)
+    out = jax.jit(
+        lambda p, x: generate(model, p, x, 8)
+    )(params, prompt)
+    print("prompt:   ", np.asarray(prompt))
+    print("generated:", np.asarray(out[:, 4:]))
+    # The copy task repeats the last prompt token indefinitely.
+    reps = np.asarray(out[:, 4:]) == np.asarray(prompt[:, -1:])
+    print(f"copy-task fidelity: {reps.mean():.2f}")
+
+
+if __name__ == "__main__":
+    main()

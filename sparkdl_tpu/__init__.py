@@ -21,6 +21,7 @@ _LAZY = {
     "DeepImageFeaturizer": "sparkdl_tpu.transformers.named_image",
     "DeepImagePredictor": "sparkdl_tpu.transformers.named_image",
     "KerasTransformer": "sparkdl_tpu.transformers.keras_tensor",
+    "DeepTextFeaturizer": "sparkdl_tpu.transformers.text",
     "KerasImageFileTransformer": "sparkdl_tpu.transformers.keras_image",
     "TFTransformer": "sparkdl_tpu.transformers.tf_tensor",
     "TFImageTransformer": "sparkdl_tpu.transformers.tf_image",

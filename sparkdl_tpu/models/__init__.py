@@ -6,6 +6,12 @@ from sparkdl_tpu.models.registry import (
     get_entry,
     registry,
 )
+from sparkdl_tpu.models.gpt import (
+    GPTConfig,
+    GPTLMHeadModel,
+    generate,
+    init_cache,
+)
 from sparkdl_tpu.models.bert import (
     BertConfig,
     BertForSequenceClassification,
@@ -21,6 +27,10 @@ __all__ = [
     "build_keras_model",
     "get_entry",
     "registry",
+    "GPTConfig",
+    "GPTLMHeadModel",
+    "generate",
+    "init_cache",
     "BertConfig",
     "BertForSequenceClassification",
     "BertModel",

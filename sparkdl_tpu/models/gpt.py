@@ -1,0 +1,322 @@
+"""Decoder-only (GPT-style) language-model family, TPU-first.
+
+The reference has no decoder models (its zoo is ImageNet CNNs; SURVEY.md
+2.1) — this family exists because a complete TPU framework must cover the
+dominant modern model shape. Design:
+
+- **RoPE** rotary positions (no position table, length-extrapolating,
+  TPU-friendly elementwise math that XLA fuses into the projections).
+- **Causal attention** with the same impl dispatch as BERT: ``full``
+  (masked softmax), ``flash`` (fused Pallas kernel, scores never hit HBM),
+  ``ring`` (exact sequence-parallel attention over the ``sp`` axis for
+  long context).
+- **Tensor parallel by construction**: qkv/out and MLP kernels carry
+  Megatron-style sharding metadata (``parallel.tensor_parallel``).
+- **Optional MoE MLP** (``num_experts > 0``): every ``moe_every``-th block
+  swaps its dense MLP for ``parallel.expert_parallel.MoEMlpBlock`` —
+  dp x tp x ep compose in one model.
+- **KV-cache generation**: an explicit functional cache (a pytree passed
+  in and returned), so prefill + single-token decode jit cleanly and
+  :func:`generate` is one ``lax.scan`` with no Python-level round trips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.parallel.expert_parallel import MoEMlpBlock
+from sparkdl_tpu.parallel.ring_attention import ring_self_attention
+from sparkdl_tpu.parallel.tensor_parallel import (
+    ColumnParallelDense,
+    RowParallelDense,
+)
+
+_NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_seq_len: int = 1024
+    rope_base: float = 10000.0
+    layer_norm_eps: float = 1e-5
+    dropout: float = 0.0
+    #: "full" | "flash" (Pallas fused kernel) | "ring" (sp-sharded)
+    attn_impl: str = "full"
+    sp_axis: str = "sp"
+    #: 0 = dense MLPs; >0 = MoE with this many experts
+    num_experts: int = 0
+    moe_every: int = 2  #: every Nth block is MoE (when num_experts > 0)
+    moe_k: int = 2
+    moe_capacity_factor: float = 2.0
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPTConfig":
+        """Test-sized config (oracle/unit tests)."""
+        defaults = dict(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+            intermediate_size=64, max_seq_len=64, dropout=0.0,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding. x: [B, L, H, D]; positions: [B, L]."""
+    half = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions[:, :, None].astype(jnp.float32) * freqs  # [B, L, half]
+    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+
+
+def init_cache(config: GPTConfig, batch: int, max_len: int) -> dict:
+    """Zeroed KV cache for :func:`generate` / incremental decode.
+
+    Layout: k/v stacked over layers, [num_layers, B, max_len, H, D];
+    ``idx`` is the number of positions already written.
+    """
+    hd = config.hidden_size // config.num_heads
+    shape = (config.num_layers, batch, max_len, config.num_heads, hd)
+    return {
+        "k": jnp.zeros(shape, config.dtype),
+        "v": jnp.zeros(shape, config.dtype),
+        "idx": jnp.zeros((), jnp.int32),
+    }
+
+
+class GPTAttention(nn.Module):
+    config: GPTConfig
+    layer_idx: int
+
+    @nn.compact
+    def __call__(self, x, *, cache: Optional[dict], train: bool,
+                 positions: Optional[jax.Array] = None):
+        c = self.config
+        h, nh = c.hidden_size, c.num_heads
+        hd = h // nh
+        b, l = x.shape[0], x.shape[1]
+
+        q = ColumnParallelDense(h, dtype=c.dtype, name="q_proj")(x)
+        k = ColumnParallelDense(h, dtype=c.dtype, name="k_proj")(x)
+        v = ColumnParallelDense(h, dtype=c.dtype, name="v_proj")(x)
+        q, k, v = (t.reshape(b, l, nh, hd) for t in (q, k, v))
+
+        idx = cache["idx"] if cache is not None else jnp.zeros((), jnp.int32)
+        if positions is None:
+            positions = idx + jnp.arange(l)[None, :]  # [1, L] -> broadcast
+            positions = jnp.broadcast_to(positions, (b, l))
+        q = apply_rope(q, positions, c.rope_base)
+        k = apply_rope(k, positions, c.rope_base)
+
+        if cache is not None:
+            # Write this call's keys/values at [idx, idx+L), then attend
+            # over the full buffer with a position mask — one code path for
+            # prefill (L>1) and decode (L=1), both jittable (idx is traced).
+            # Overflow past the buffer would silently clamp the write while
+            # the mask keeps advancing — catch it whenever idx is concrete
+            # (eager streaming drivers; generate() pre-validates its scan).
+            max_len = cache["k"].shape[2]
+            if not isinstance(idx, jax.core.Tracer) and int(idx) + l > max_len:
+                raise ValueError(
+                    f"KV cache overflow: idx {int(idx)} + {l} new tokens > "
+                    f"cache max_len {max_len}"
+                )
+            ck = jax.lax.dynamic_update_slice(
+                cache["k"][self.layer_idx], k.astype(c.dtype),
+                (0, idx, 0, 0),
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cache["v"][self.layer_idx], v.astype(c.dtype),
+                (0, idx, 0, 0),
+            )
+            new_entry = (ck, cv)
+            max_len = ck.shape[1]
+            q_pos = idx + jnp.arange(l)  # [L]
+            k_pos = jnp.arange(max_len)  # [max_len]
+            mask = k_pos[None, :] <= q_pos[:, None]  # causal + not-yet-written
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, ck,
+                preferred_element_type=jnp.float32,
+            ) / math.sqrt(hd)
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+            p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", p, cv)
+        else:
+            new_entry = None
+            if c.attn_impl == "flash":
+                from sparkdl_tpu.ops.flash_attention import flash_attention
+
+                ctx = flash_attention(q, k, v, causal=True)
+            elif c.attn_impl == "ring":
+                ctx = ring_self_attention(
+                    q, k, v, axis_name=c.sp_axis, causal=True
+                )
+            else:
+                s = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q, k,
+                    preferred_element_type=jnp.float32,
+                ) / math.sqrt(hd)
+                causal = jnp.tril(jnp.ones((l, l), bool))
+                s = jnp.where(causal[None, None], s, _NEG_INF)
+                p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
+                p = nn.Dropout(c.dropout, deterministic=not train)(p)
+                ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+        out = RowParallelDense(h, dtype=c.dtype, name="out_proj")(
+            ctx.reshape(b, l, h)
+        )
+        return out, new_entry
+
+
+class GPTBlock(nn.Module):
+    config: GPTConfig
+    layer_idx: int
+
+    @nn.compact
+    def __call__(self, x, *, cache: Optional[dict], train: bool,
+                 positions: Optional[jax.Array] = None):
+        c = self.config
+        a, new_entry = GPTAttention(c, self.layer_idx, name="attn")(
+            nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
+                         name="ln_1")(x),
+            cache=cache, train=train, positions=positions,
+        )
+        x = x + nn.Dropout(c.dropout, deterministic=not train)(a)
+
+        h = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
+                         name="ln_2")(x)
+        is_moe = c.num_experts > 0 and (self.layer_idx % c.moe_every
+                                        == c.moe_every - 1)
+        if is_moe:
+            m = MoEMlpBlock(
+                num_experts=c.num_experts,
+                hidden_features=c.intermediate_size,
+                k=c.moe_k, capacity_factor=c.moe_capacity_factor,
+                dtype=c.dtype, name="moe_mlp",
+            )(h)
+        else:
+            up = ColumnParallelDense(c.intermediate_size, dtype=c.dtype,
+                                     name="up")(h)
+            m = RowParallelDense(c.hidden_size, dtype=c.dtype, name="down")(
+                nn.gelu(up)
+            )
+        x = x + nn.Dropout(c.dropout, deterministic=not train)(m)
+        return x, new_entry
+
+
+class GPTLMHeadModel(nn.Module):
+    """Decoder LM. ``__call__(input_ids, cache=None)`` -> (logits, cache).
+
+    Without a cache: full causal forward (training / scoring), attention
+    impl per ``config.attn_impl``. With a cache from :func:`init_cache`:
+    writes K/V at ``cache['idx']`` and returns the updated cache —
+    the building block :func:`generate` scans.
+
+    ``positions``: optional [B, L] global token positions for RoPE.
+    REQUIRED under ``attn_impl='ring'`` (sequence sharded on ``sp``): each
+    shard must pass its global positions, not 0..L/sp-1 — the ring kernel
+    offsets its causal mask globally, and RoPE must agree with it.
+    """
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, cache: Optional[dict] = None,
+                 train: bool = False,
+                 positions: Optional[jax.Array] = None):
+        c = self.config
+        wte = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
+                       name="wte")
+        x = wte(input_ids)
+        x = nn.Dropout(c.dropout, deterministic=not train)(x)
+
+        new_ks, new_vs = [], []
+        for i in range(c.num_layers):
+            x, entry = GPTBlock(c, i, name=f"h_{i}")(
+                x, cache=cache, train=train, positions=positions
+            )
+            if entry is not None:
+                new_ks.append(entry[0])
+                new_vs.append(entry[1])
+
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
+                         name="ln_f")(x)
+        logits = wte.attend(x).astype(jnp.float32)  # weight-tied LM head
+
+        if cache is not None:
+            cache = {
+                "k": jnp.stack(new_ks),
+                "v": jnp.stack(new_vs),
+                "idx": cache["idx"] + input_ids.shape[1],
+            }
+        return logits, cache
+
+
+def generate(
+    model: GPTLMHeadModel,
+    variables: Any,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Autoregressive decode: prefill the prompt, then one lax.scan step
+    per token (KV-cached, single jittable program — no Python loop).
+
+    temperature 0 = greedy; >0 = sampled (requires ``rng``).
+    Returns [B, prompt_len + max_new_tokens] token ids.
+    """
+    b, lp = prompt_ids.shape
+    if max_len is None:
+        max_len = lp + max_new_tokens
+    elif max_len < lp + max_new_tokens:
+        raise ValueError(
+            f"max_len={max_len} < prompt_len {lp} + max_new_tokens "
+            f"{max_new_tokens}: cache writes would silently clamp"
+        )
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature>0) requires rng")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def sample(logits, key):
+        if temperature > 0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    cache = init_cache(model.config, b, max_len)
+    logits, cache = model.apply(variables, prompt_ids, cache=cache)
+    rng, key = jax.random.split(rng)
+    tok = sample(logits[:, -1], key)
+
+    def step(carry, _):
+        cache, tok, rng = carry
+        logits, cache = model.apply(variables, tok[:, None], cache=cache)
+        rng, key = jax.random.split(rng)
+        nxt = sample(logits[:, -1], key)
+        return (cache, nxt, rng), tok
+
+    # step i consumes the token at position lp+i and emits it; after N
+    # steps ``toks`` holds exactly the N generated tokens (the final
+    # carry's token is the N+1th, beyond max_new_tokens — dropped).
+    _, toks = jax.lax.scan(
+        step, (cache, tok, rng), None, length=max_new_tokens
+    )
+    return jnp.concatenate([prompt_ids, toks.swapaxes(0, 1)], axis=1)

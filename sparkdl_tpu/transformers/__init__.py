@@ -3,9 +3,11 @@ from sparkdl_tpu.transformers.named_image import (
     DeepImagePredictor,
 )
 from sparkdl_tpu.transformers.keras_tensor import KerasTransformer
+from sparkdl_tpu.transformers.text import DeepTextFeaturizer
 
 __all__ = [
     "DeepImageFeaturizer",
     "DeepImagePredictor",
     "KerasTransformer",
+    "DeepTextFeaturizer",
 ]

@@ -1,0 +1,170 @@
+"""DeepTextFeaturizer — BERT-backed text featurization over DataFrames.
+
+Text-side sibling of ``DeepImageFeaturizer`` (the reference has no text
+models at all — its zoo is ImageNet CNNs, SURVEY.md 2.1 — but its BERT
+benchmark config and the transformer surface invite exactly this class):
+a column of token-id arrays goes in, pooled encoder features come out as a
+float array column ready for a downstream classifier — the same
+transfer-learning shape as image featurization.
+
+Rows are padded/truncated to ``maxLength``, bucketed by batch (one XLA
+compile per bucket, shared per process) and featurized by a jitted BERT
+forward. Tokenization is upstream of this transformer (the reference's
+imageLoader pattern: bring your own loader); pair with any tokenizer that
+yields int ids, e.g. ``transformers.AutoTokenizer``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from sparkdl_tpu.dataframe import transform_partitions
+from sparkdl_tpu.param import (
+    HasBatchSize,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    SparkDLTypeConverters,
+    Transformer,
+)
+from sparkdl_tpu.transformers._inference import (
+    BatchedRunner,
+    run_partition_with_passthrough,
+)
+
+_POOLINGS = ("cls", "mean", "pooler")
+
+#: per-process runner cache: one jitted BERT forward per (weights, config,
+#: pooling, shapes) no matter how many partitions/tasks deserialize the
+#: transformer (the sibling transformers key by model *file path*; here the
+#: model arrives as live arrays, so the stable cross-deserialization key is
+#: a content fingerprint).
+_RUNNER_CACHE: dict = {}
+_FINGERPRINTS: dict = {}  # id(variables) -> digest (valid while referenced)
+
+
+def _fingerprint(variables) -> str:
+    import jax
+
+    key = id(variables)
+    fp = _FINGERPRINTS.get(key)
+    if fp is None:
+        h = hashlib.blake2b(digest_size=16)
+        for path, leaf in sorted(
+            jax.tree_util.tree_flatten_with_path(variables)[0],
+            key=lambda kv: str(kv[0]),
+        ):
+            h.update(str(path).encode())
+            h.update(np.asarray(leaf).tobytes())
+        fp = h.hexdigest()
+        _FINGERPRINTS[key] = fp
+    return fp
+
+
+def _to_bundle(value):
+    """Validate the model param: (BertConfig, variables) pair."""
+    from sparkdl_tpu.models.bert import BertConfig
+
+    if (
+        isinstance(value, tuple)
+        and len(value) == 2
+        and isinstance(value[0], BertConfig)
+    ):
+        return value
+    raise TypeError(
+        "model must be a (BertConfig, variables) tuple, e.g. from "
+        "models.bert.load_hf_bert(...) or (cfg, BertModel(cfg).init(...))"
+    )
+
+
+class DeepTextFeaturizer(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
+    model = Param(None, "model", "(BertConfig, variables) encoder bundle",
+                  _to_bundle)
+    pooling = Param(
+        None, "pooling",
+        "how to pool token features: 'cls' (first token), 'mean' "
+        "(mask-weighted mean), 'pooler' (HF tanh pooler head)",
+        SparkDLTypeConverters.toString,
+    )
+    maxLength = Param(None, "maxLength",
+                      "pad/truncate token ids to this length",
+                      SparkDLTypeConverters.toInt)
+
+    def __init__(self, inputCol=None, outputCol=None, model=None,
+                 pooling=None, maxLength=None, batchSize=None):
+        super().__init__()
+        self._setDefault(pooling="mean", maxLength=128, batchSize=64)
+        self._set(inputCol=inputCol, outputCol=outputCol, model=model,
+                  pooling=pooling, maxLength=maxLength, batchSize=batchSize)
+
+    def setModel(self, value):
+        return self._set(model=value)
+
+    def _transform(self, dataset):
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.models.bert import BertModel
+
+        cfg, variables = self.getOrDefault("model")
+        pooling = self.getOrDefault("pooling")
+        if pooling not in _POOLINGS:
+            raise ValueError(f"pooling must be one of {_POOLINGS}, "
+                             f"got {pooling!r}")
+        max_len = self.getOrDefault("maxLength")
+        input_col = self.getInputCol()
+        output_col = self.getOutputCol()
+        module = BertModel(cfg, add_pooler=pooling == "pooler")
+
+        batch_size = self.getBatchSize()
+
+        def make_runner():
+            def apply_fn(batch):
+                ids = batch["input_ids"].astype(jnp.int32)
+                mask = batch["attention_mask"].astype(jnp.int32)
+                seq, pooled = module.apply(variables, ids, mask)
+                if pooling == "pooler":
+                    out = pooled
+                elif pooling == "cls":
+                    out = seq[:, 0]
+                else:  # mask-weighted mean over real tokens
+                    m = mask[:, :, None].astype(seq.dtype)
+                    out = jnp.sum(seq * m, axis=1) / jnp.clip(
+                        jnp.sum(m, axis=1), 1
+                    )
+                return out.astype(jnp.float32)
+
+            return BatchedRunner(apply_fn, batch_size=batch_size)
+
+        def partition_fn(rows):
+            rows = list(rows)
+            if not rows:
+                return iter(())
+            key = (_fingerprint(variables), cfg, pooling, max_len, batch_size)
+            runner = _RUNNER_CACHE.get(key)
+            if runner is None:
+                runner = _RUNNER_CACHE[key] = make_runner()
+
+            def extract(row):
+                ids = np.asarray(row[input_col], dtype=np.int32)
+                if ids.ndim != 1:
+                    raise ValueError(
+                        f"token-id input must be 1-D, got {ids.shape}"
+                    )
+                n = min(len(ids), max_len)
+                padded = np.zeros(max_len, np.int32)
+                padded[:n] = ids[:n]
+                mask = np.zeros(max_len, np.int32)
+                mask[:n] = 1
+                return {"input_ids": padded, "attention_mask": mask}
+
+            return run_partition_with_passthrough(
+                rows, extract, runner, output_col,
+                lambda o: np.asarray(o, dtype=np.float32),
+                input_cols=(input_col,),
+            )
+
+        return transform_partitions(
+            dataset, partition_fn, [(output_col, "array<float>")]
+        )

@@ -84,3 +84,25 @@ def test_ring_under_jit(sp_mesh):
     out = jax.jit(lambda q: ring_attention(q, q, q, sp_mesh))(q)
     want = full_attention(q, q, q)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+
+
+def test_long_context_sp8_bf16():
+    """Long-context shape: L=2048 sharded 8-way, bf16 inputs — the regime
+    ring attention exists for. Oracle = full attention at f32."""
+    mesh = MeshSpec(dp=1, sp=8).build()
+    rng = np.random.default_rng(7)
+    shape = (1, 2048, 2, 16)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal(shape), jnp.float32).astype(jnp.bfloat16)
+        for _ in range(3)
+    )
+    got = ring_attention(q, k, v, mesh, causal=True, batch_axes=("dp",))
+    want = full_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        causal=True,
+    )
+    assert got.dtype == jnp.bfloat16
+    # bf16 inputs with f32 accumulation: tolerance set by bf16 rounding.
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), atol=2e-2
+    )

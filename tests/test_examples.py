@@ -39,6 +39,11 @@ def test_sql_udf_scoring():
     assert "udf 'score_image'" in out
 
 
+def test_gpt_generation():
+    out = _run("gpt_generation.py", "--steps", "25")
+    assert "copy-task fidelity" in out
+
+
 @pytest.mark.slow
 def test_distributed_resnet_training():
     out = _run("distributed_resnet_training.py", "--steps", "2")

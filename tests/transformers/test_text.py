@@ -1,0 +1,129 @@
+"""DeepTextFeaturizer: oracle vs direct BertModel forward, pooling modes,
+padding/truncation, and bad-row tolerance."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.dataframe.local import LocalDataFrame
+from sparkdl_tpu.models.bert import BertConfig, BertModel
+from sparkdl_tpu.transformers.text import DeepTextFeaturizer
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    cfg = BertConfig.tiny(vocab_size=64)
+    variables = BertModel(cfg).init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32),
+    )
+    return cfg, variables
+
+
+def _df(rng, n=11, vocab=64):
+    rows = [
+        {"id": i,
+         "tokens": rng.integers(1, vocab, rng.integers(3, 16)).astype(int)}
+        for i in range(n)
+    ]
+    return rows, LocalDataFrame([rows[: n // 2], rows[n // 2:]])
+
+
+def test_mean_pooling_matches_direct_forward(bundle):
+    cfg, variables = bundle
+    rng = np.random.default_rng(0)
+    rows, df = _df(rng)
+    ft = DeepTextFeaturizer(
+        inputCol="tokens", outputCol="features", model=(cfg, variables),
+        maxLength=16,
+    )
+    out = ft.transform(df).collect()
+    assert [r["id"] for r in out] == [r["id"] for r in rows]
+
+    model = BertModel(cfg, add_pooler=False)
+    for r_in, r_out in zip(rows, out):
+        ids = np.zeros(16, np.int32)
+        n = len(r_in["tokens"])
+        ids[:n] = r_in["tokens"]
+        mask = (np.arange(16) < n).astype(np.int32)
+        seq, _ = model.apply(variables, jnp.asarray(ids[None]),
+                             jnp.asarray(mask[None]))
+        m = mask[None, :, None]
+        want = (np.asarray(seq) * m).sum(1) / m.sum(1)
+        np.testing.assert_allclose(
+            np.asarray(r_out["features"]), want[0], atol=1e-4
+        )
+
+
+def test_cls_and_pooler_modes(bundle):
+    cfg, variables = bundle
+    rng = np.random.default_rng(1)
+    rows, df = _df(rng, n=4)
+    for pooling, dim in (("cls", cfg.hidden_size), ("pooler", cfg.hidden_size)):
+        ft = DeepTextFeaturizer(
+            inputCol="tokens", outputCol="f", model=(cfg, variables),
+            pooling=pooling, maxLength=16,
+        )
+        out = ft.transform(df).collect()
+        assert all(len(r["f"]) == dim for r in out)
+
+
+def test_truncation_beyond_max_length(bundle):
+    cfg, variables = bundle
+    long_row = {"tokens": np.arange(1, 60) % 63 + 1}
+    df = LocalDataFrame([[long_row]])
+    ft = DeepTextFeaturizer(
+        inputCol="tokens", outputCol="f", model=(cfg, variables), maxLength=8
+    )
+    out = ft.transform(df).collect()
+    assert len(out) == 1 and np.all(np.isfinite(out[0]["f"]))
+
+
+def test_bad_rows_get_none(bundle):
+    cfg, variables = bundle
+    df = LocalDataFrame([[
+        {"tokens": np.asarray([1, 2, 3])},
+        {"tokens": np.asarray([[1, 2], [3, 4]])},  # 2-D: rejected
+    ]])
+    ft = DeepTextFeaturizer(
+        inputCol="tokens", outputCol="f", model=(cfg, variables), maxLength=8
+    )
+    out = ft.transform(df).collect()
+    assert out[0]["f"] is not None
+    assert out[1]["f"] is None
+
+
+def test_invalid_pooling_rejected(bundle):
+    cfg, variables = bundle
+    df = LocalDataFrame([[{"tokens": np.asarray([1, 2])}]])
+    ft = DeepTextFeaturizer(
+        inputCol="tokens", outputCol="f", model=(cfg, variables),
+        pooling="max",
+    )
+    with pytest.raises(ValueError, match="pooling"):
+        ft.transform(df)
+
+
+def test_runner_cached_across_transforms(bundle):
+    from sparkdl_tpu.transformers import text as text_mod
+
+    cfg, variables = bundle
+    rng = np.random.default_rng(2)
+    _, df = _df(rng, n=3)
+    # distinct maxLength => cache key no earlier test in this module used
+    kw = dict(inputCol="tokens", outputCol="f", model=(cfg, variables),
+              maxLength=12)
+    before = len(text_mod._RUNNER_CACHE)
+    DeepTextFeaturizer(**kw).transform(df).collect()
+    mid = len(text_mod._RUNNER_CACHE)
+    # A second transformer instance with identical weights/config reuses
+    # the jitted runner instead of recompiling.
+    DeepTextFeaturizer(**kw).transform(df).collect()
+    assert len(text_mod._RUNNER_CACHE) == mid
+    assert mid == before + 1
+
+
+def test_invalid_model_bundle_rejected():
+    with pytest.raises(TypeError, match="BertConfig"):
+        DeepTextFeaturizer(inputCol="t", outputCol="f", model="bert-base")
