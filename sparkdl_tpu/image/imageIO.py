@@ -92,6 +92,26 @@ def PIL_decode_bytes(raw: bytes, origin: str = "") -> dict | None:
     return imageArrayToStructBGR(arr, origin) if arr.ndim == 3 else imageArrayToStruct(arr, origin)
 
 
+def native_decode_bytes(raw: bytes, origin: str = "") -> dict | None:
+    """Like :func:`PIL_decode_bytes` but via the native libjpeg/libpng
+    decoder (``native.decode``) — threaded C decode instead of PIL, the
+    host-ingest equivalent of the reference's in-JVM decode (SURVEY.md
+    2.2). Falls back to PIL when the native library is unavailable, for
+    formats the native path does not cover (e.g. GIF), and for grayscale
+    sources (PIL keeps them 1-channel CV_8UC1; the native decoder always
+    emits RGB — deferring keeps the struct schema independent of which
+    decoder a host happens to have)."""
+    from sparkdl_tpu.native import decode as _native_decode
+
+    if _native_decode.available():
+        info = _native_decode.image_info(raw)
+        if info is not None and info[2] == 3:
+            arr = _native_decode.decode_resize(raw)
+            if arr is not None:
+                return imageArrayToStructBGR(arr, origin)
+    return PIL_decode_bytes(raw, origin)
+
+
 def undefined_image(origin: str = "") -> dict:
     return image_struct(b"", -1, -1, -1, UNDEFINED_MODE, origin)
 

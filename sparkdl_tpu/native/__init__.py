@@ -1,16 +1,20 @@
-"""Native (C++) host runtime: staging ring, row packing, device feeder.
+"""Native (C++) host runtime: staging ring, row packing, device feeder,
+image decode.
 
 The reference's native layer is the TensorFrames JNI bridge + Horovod core
 (SURVEY.md 2.15/2.16) — JVM-centric machinery for getting DataFrame blocks
 into TF sessions and gradients across GPUs. The TPU equivalents split
 differently: gradient comm belongs to XLA/ICI (nothing to hand-write), so
 the native surface that matters is the *host side of the infeed* — stable
-staging memory, threaded batch assembly, transfer/compute overlap. That is
-what this package provides, as a ctypes-bound C++ library with pure-Python
-fallbacks (same API, lower throughput) when no toolchain is present.
+staging memory, threaded batch assembly, transfer/compute overlap, and
+JPEG/PNG decode+resize (the work the reference's in-JVM ImageUtils does,
+SURVEY.md 2.2). That is what this package provides, as ctypes-bound C++
+libraries with pure-Python fallbacks (same API, lower throughput) when no
+toolchain is present.
 """
 
 from sparkdl_tpu.native._lib import available
+from sparkdl_tpu.native import decode
 from sparkdl_tpu.native.bridge import (
     DeviceFeeder,
     StagingRing,
@@ -18,4 +22,5 @@ from sparkdl_tpu.native.bridge import (
     u8_to_f32,
 )
 
-__all__ = ["available", "DeviceFeeder", "StagingRing", "pack_rows", "u8_to_f32"]
+__all__ = ["available", "decode", "DeviceFeeder", "StagingRing", "pack_rows",
+           "u8_to_f32"]

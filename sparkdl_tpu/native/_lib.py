@@ -1,9 +1,11 @@
-"""Build-on-first-import loader + ctypes signatures for libsdlbridge.
+"""Build-on-first-import loaders + ctypes signatures for the native libs.
 
 No pybind11 in the image, so the binding layer is ctypes over a plain C
-ABI (see csrc/sdl_bridge.cc). The .so is compiled lazily with g++ and
-cached under ``_build/``; environments without a toolchain simply get
-``lib() -> None`` and the pure-Python fallbacks in bridge.py take over.
+ABI. Each .so is compiled lazily with g++ and cached under ``_build/``;
+environments without a toolchain (or without a lib's link dependencies)
+simply get ``lib() -> None`` for that library and the pure-Python
+fallbacks take over — the staging ring (csrc/sdl_bridge.cc) and the image
+decoder (csrc/sdl_decode.cc, links libjpeg/libpng) fail independently.
 """
 
 from __future__ import annotations
@@ -13,44 +15,94 @@ import logging
 import os
 import subprocess
 import threading
+from typing import Callable, Sequence
 
 logger = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "csrc", "sdl_bridge.cc")
 _BUILD_DIR = os.path.join(_HERE, "_build")
-_SO = os.path.join(_BUILD_DIR, "libsdlbridge.so")
-
-_lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_tried = False
 
 
-def _compile() -> bool:
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    # per-process tmp name: concurrent first imports (several executor
-    # processes on one host) must not write through the same tmp inode;
-    # whichever os.replace lands last wins, both are valid builds.
-    tmp = f"{_SO}.tmp.{os.getpid()}"
-    cmd = [
-        os.environ.get("CXX", "g++"),
-        "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-        "-o", tmp, _SRC,
-    ]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)  # atomic publish
-        return True
-    except (OSError, subprocess.SubprocessError) as e:
-        detail = getattr(e, "stderr", b"") or b""
-        logger.warning(
-            "sdl_bridge native build failed (%s); using pure-Python staging. %s",
-            e, detail.decode(errors="replace")[:500],
-        )
-        return False
+class NativeLib:
+    """One lazily-built native library: compile, cache, declare, fall back."""
+
+    def __init__(self, name: str, source: str,
+                 declare: Callable[[ctypes.CDLL], ctypes.CDLL],
+                 link_flags: Sequence[str] = ()):
+        self._name = name
+        self._src = os.path.join(_HERE, "csrc", source)
+        self._so = os.path.join(_BUILD_DIR, f"lib{name}.so")
+        self._declare = declare
+        self._link_flags = list(link_flags)
+        self._lock = threading.Lock()
+        self._lib: ctypes.CDLL | None = None
+        self._tried = False
+
+    def _compile(self) -> bool:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        # per-process tmp name: concurrent first imports (several executor
+        # processes on one host) must not write through the same tmp inode;
+        # whichever os.replace lands last wins, both are valid builds.
+        tmp = f"{self._so}.tmp.{os.getpid()}"
+        cmd = [
+            os.environ.get("CXX", "g++"),
+            "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+            "-o", tmp, self._src, *self._link_flags,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, self._so)  # atomic publish
+            return True
+        except (OSError, subprocess.SubprocessError) as e:
+            detail = getattr(e, "stderr", b"") or b""
+            logger.warning(
+                "%s native build failed (%s); using pure-Python fallback. %s",
+                self._name, e, detail.decode(errors="replace")[:500],
+            )
+            return False
+
+    def lib(self) -> ctypes.CDLL | None:
+        """The loaded library, building it if needed; None if unavailable."""
+        if self._lib is not None or self._tried:
+            return self._lib
+        with self._lock:
+            if self._lib is not None or self._tried:
+                return self._lib
+            self._tried = True
+            if os.environ.get("SPARKDL_TPU_DISABLE_NATIVE"):
+                logger.info(
+                    "%s disabled via SPARKDL_TPU_DISABLE_NATIVE", self._name
+                )
+                return None
+            # Rebuild when the cached .so predates the source (git pull with
+            # a persisting _build/), not only when it is absent. A deployment
+            # may ship the prebuilt .so without csrc/ — a missing source is
+            # simply "not stale", never an error.
+            try:
+                stale = (
+                    os.path.exists(self._so)
+                    and os.path.getmtime(self._so) < os.path.getmtime(self._src)
+                )
+            except OSError:
+                stale = False
+            if (not os.path.exists(self._so) or stale) and not self._compile():
+                if not os.path.exists(self._so):
+                    return None  # no cached build to fall back to
+            try:
+                self._lib = self._declare(ctypes.CDLL(self._so))
+            except (OSError, AttributeError) as e:
+                # OSError: corrupt/foreign .so. AttributeError: a cached
+                # build missing a newer export — either way fall back to
+                # pure Python instead of erroring in every batch assembly.
+                logger.warning("could not load %s: %s", self._so, e)
+                self._lib = None
+            return self._lib
+
+    def available(self) -> bool:
+        return self.lib() is not None
 
 
-def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+def _declare_bridge(lib: ctypes.CDLL) -> ctypes.CDLL:
     c = ctypes
     lib.sdl_ring_create.restype = c.c_void_p
     lib.sdl_ring_create.argtypes = [c.c_uint64, c.c_uint32]
@@ -87,42 +139,43 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+def _declare_decode(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.sdl_image_info.restype = c.c_int32
+    lib.sdl_image_info.argtypes = [
+        c.POINTER(c.c_uint8), c.c_uint64,
+        c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+    ]
+    lib.sdl_decode_resize.restype = c.c_int32
+    lib.sdl_decode_resize.argtypes = [
+        c.POINTER(c.c_uint8), c.c_uint64, c.c_int32, c.c_int32,
+        c.POINTER(c.c_uint8),
+    ]
+    lib.sdl_decode_resize_batch.argtypes = [
+        c.c_uint64, c.POINTER(c.POINTER(c.c_uint8)),
+        c.POINTER(c.c_uint64), c.c_int32, c.c_int32,
+        c.POINTER(c.c_uint8), c.c_int32, c.POINTER(c.c_int32),
+    ]
+    return lib
+
+
+_BRIDGE = NativeLib("sdlbridge", "sdl_bridge.cc", _declare_bridge)
+_DECODE = NativeLib("sdldecode", "sdl_decode.cc", _declare_decode,
+                    link_flags=("-ljpeg", "-lpng"))
+
+
 def lib() -> ctypes.CDLL | None:
-    """The loaded native library, building it if needed; None if unavailable."""
-    global _lib, _tried
-    if _lib is not None or _tried:
-        return _lib
-    with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        if os.environ.get("SPARKDL_TPU_DISABLE_NATIVE"):
-            logger.info("native bridge disabled via SPARKDL_TPU_DISABLE_NATIVE")
-            return None
-        # Rebuild when the cached .so predates the source (git pull with a
-        # persisting _build/), not only when it is absent. A deployment may
-        # ship the prebuilt .so without csrc/ — a missing source is simply
-        # "not stale", never an error.
-        try:
-            stale = (
-                os.path.exists(_SO)
-                and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
-            )
-        except OSError:
-            stale = False
-        if (not os.path.exists(_SO) or stale) and not _compile():
-            if not os.path.exists(_SO):
-                return None  # no cached build to fall back to
-        try:
-            _lib = _declare(ctypes.CDLL(_SO))
-        except (OSError, AttributeError) as e:
-            # OSError: corrupt/foreign .so. AttributeError: a cached build
-            # missing a newer export — either way fall back to pure Python
-            # instead of letting the error escape into every batch assembly.
-            logger.warning("could not load %s: %s", _SO, e)
-            _lib = None
-        return _lib
+    """The staging-bridge library (back-compat name)."""
+    return _BRIDGE.lib()
 
 
 def available() -> bool:
-    return lib() is not None
+    return _BRIDGE.available()
+
+
+def decode_lib() -> ctypes.CDLL | None:
+    return _DECODE.lib()
+
+
+def decode_available() -> bool:
+    return _DECODE.available()

@@ -146,3 +146,32 @@ def test_non_placeholder_input_rejected():
         issn.run(tf.compat.v1.global_variables_initializer())
         with pytest.raises(ValueError, match="Placeholder"):
             TFInputGraph.fromGraph(issn.graph, issn.sess, ["y"], ["y"])
+
+
+def test_from_tf2_object_based_saved_model(tmp_path):
+    """Modern (TF2 object-based, function-traced) SavedModels ingest through
+    the same constructor as TF1 frozen-graph ones — regression pin, since
+    most exported models today are this shape."""
+    import numpy as np
+    import tensorflow as tf
+
+    from sparkdl_tpu.graph.input import TFInputGraph
+
+    class M(tf.Module):
+        def __init__(self):
+            self.w = tf.Variable(tf.random.normal([8, 4], seed=1))
+
+        @tf.function(input_signature=[tf.TensorSpec([None, 8], tf.float32)])
+        def serve(self, x):
+            return {"y": tf.nn.relu(x @ self.w)}
+
+    m = M()
+    d = str(tmp_path / "tf2sm")
+    tf.saved_model.save(m, d, signatures={"serving_default": m.serve})
+
+    g = TFInputGraph.fromSavedModelWithSignature(d)
+    fn = g.asGraphFunction().to_jax()
+    x = np.random.default_rng(0).standard_normal((3, 8)).astype(np.float32)
+    out = np.asarray(fn(x)[0])
+    want = np.maximum(x @ m.w.numpy(), 0)
+    np.testing.assert_allclose(out, want, atol=1e-5)

@@ -1,0 +1,118 @@
+"""Native decode: PIL oracle on PNG (lossless -> exact), JPEG near-match,
+resize vs jax.image.resize sampling, threaded batch with corrupt rows,
+and the imageIO struct hook."""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from sparkdl_tpu.native import decode
+from sparkdl_tpu.image import imageIO
+
+pytestmark = pytest.mark.skipif(
+    not decode.available(), reason="native decode lib unavailable"
+)
+
+
+def _png_bytes(arr):
+    b = io.BytesIO()
+    Image.fromarray(arr).save(b, format="PNG")
+    return b.getvalue()
+
+
+def _jpeg_bytes(arr, quality=95):
+    b = io.BytesIO()
+    Image.fromarray(arr).save(b, format="JPEG", quality=quality)
+    return b.getvalue()
+
+
+@pytest.fixture(scope="module")
+def rgb():
+    return np.random.default_rng(0).integers(
+        0, 255, (40, 56, 3)
+    ).astype(np.uint8)
+
+
+def test_image_info(rgb):
+    assert decode.image_info(_png_bytes(rgb)) == (40, 56, 3)
+    assert decode.image_info(_jpeg_bytes(rgb)) == (40, 56, 3)
+    gray = rgb[:, :, 0]
+    assert decode.image_info(_png_bytes(gray)) == (40, 56, 1)
+    assert decode.image_info(b"garbage") is None
+
+
+def test_partial_target_size_rejected(rgb):
+    with pytest.raises(ValueError, match="both height and width"):
+        decode.decode_resize(_png_bytes(rgb), height=24)
+
+
+def test_grayscale_struct_matches_pil(rgb):
+    # Grayscale must produce the same 1-channel struct whichever decoder
+    # a host has — the native path defers to PIL for it.
+    raw = _png_bytes(rgb[:, :, 0])
+    got = imageIO.native_decode_bytes(raw, "o")
+    want = imageIO.PIL_decode_bytes(raw, "o")
+    assert got["mode"] == want["mode"]
+    np.testing.assert_array_equal(
+        imageIO.imageStructToArray(got), imageIO.imageStructToArray(want)
+    )
+
+
+def test_png_decode_exact(rgb):
+    got = decode.decode_resize(_png_bytes(rgb))
+    np.testing.assert_array_equal(got, rgb)
+
+
+def test_jpeg_decode_close_to_pil(rgb):
+    raw = _jpeg_bytes(rgb)
+    got = decode.decode_resize(raw).astype(np.int16)
+    want = np.asarray(Image.open(io.BytesIO(raw)).convert("RGB"), np.int16)
+    # Two libjpeg IDCT paths may round differently by a few counts.
+    assert np.mean(np.abs(got - want)) < 2.0
+
+
+def test_resize_matches_jax_bilinear(rgb):
+    import jax
+    import jax.numpy as jnp
+
+    got = decode.decode_resize(_png_bytes(rgb), 24, 32).astype(np.float32)
+    want = np.asarray(
+        jax.image.resize(
+            jnp.asarray(rgb, jnp.float32), (24, 32, 3), method="bilinear"
+        )
+    )
+    # u8 quantization on the native path; sampling grid must agree.
+    assert np.mean(np.abs(got - want)) < 1.0
+    assert np.max(np.abs(got - want)) <= 3.0
+
+
+def test_batch_decode_with_corrupt_rows(rgb):
+    other = (255 - rgb)[:30, :20]
+    raws = [_png_bytes(rgb), b"not an image", _jpeg_bytes(other)]
+    batch, statuses = decode.decode_resize_batch(raws, 24, 24, n_threads=4)
+    assert batch.shape == (3, 24, 24, 3)
+    assert statuses[0] == 0 and statuses[2] == 0
+    assert statuses[1] != 0
+    assert np.all(batch[1] == 0)  # failed row zeroed
+    assert batch[0].any() and batch[2].any()
+
+
+def test_batch_empty():
+    batch, statuses = decode.decode_resize_batch([], 8, 8)
+    assert batch.shape == (0, 8, 8, 3) and statuses.shape == (0,)
+
+
+def test_native_decode_bytes_struct_matches_pil(rgb):
+    raw = _png_bytes(rgb)
+    got = imageIO.native_decode_bytes(raw, origin="mem://x")
+    want = imageIO.PIL_decode_bytes(raw, origin="mem://x")
+    assert got["mode"] == want["mode"]
+    np.testing.assert_array_equal(
+        imageIO.imageStructToArray(got), imageIO.imageStructToArray(want)
+    )
+
+
+def test_native_decode_bytes_falls_back_on_garbage():
+    assert imageIO.native_decode_bytes(b"garbage", "o") is None
