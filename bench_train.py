@@ -24,6 +24,7 @@ def main() -> None:
 
     from sparkdl_tpu.models.resnet import ResNet50
     from sparkdl_tpu.observability.metrics import StepMeter, compiled_flops
+    from sparkdl_tpu.train.vision import make_vision_train_step
 
     platform = jax.default_backend()
     on_accel = platform not in ("cpu",)
@@ -39,25 +40,7 @@ def main() -> None:
     params, batch_stats = variables["params"], variables["batch_stats"]
     tx = optax.sgd(0.1, momentum=0.9)
     opt_state = tx.init(params)
-
-    def loss_fn(params, batch_stats, x, y):
-        (_, probs), updates = model.apply(
-            {"params": params, "batch_stats": batch_stats},
-            x, train=True, mutable=["batch_stats"],
-        )
-        logp = jnp.log(jnp.clip(probs, 1e-8))
-        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
-        return loss, updates["batch_stats"]
-
-    import functools
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-    def train_step(params, batch_stats, opt_state, x, y):
-        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, batch_stats, x, y
-        )
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), stats, opt_state, loss
+    train_step = make_vision_train_step(model, tx, donate=True)
 
     rng = np.random.default_rng(0)
     x = jax.device_put(rng.random((batch, size, size, 3), np.float32))

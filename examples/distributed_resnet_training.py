@@ -27,6 +27,7 @@ def train_fn(steps: int = 3, batch_per_device: int = 2, size: int = 32):
 
     from sparkdl_tpu.models.resnet import ResNet50
     from sparkdl_tpu.runtime.mesh import data_parallel_mesh
+    from sparkdl_tpu.train.vision import make_vision_train_step
 
     mesh = data_parallel_mesh()  # every device across every process on dp
     n_dev = jax.device_count()
@@ -38,23 +39,7 @@ def train_fn(steps: int = 3, batch_per_device: int = 2, size: int = 32):
     )
     params, batch_stats = variables["params"], variables["batch_stats"]
     tx = optax.sgd(1e-2, momentum=0.9)
-
-    def loss_fn(params, batch_stats, x, y):
-        (_, probs), updates = model.apply(
-            {"params": params, "batch_stats": batch_stats},
-            x, train=True, mutable=["batch_stats"],
-        )
-        logp = jnp.log(jnp.clip(probs, 1e-8))
-        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
-        return loss, updates["batch_stats"]
-
-    @jax.jit
-    def train_step(params, batch_stats, opt_state, x, y):
-        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, batch_stats, x, y
-        )
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), stats, opt_state, loss
+    train_step = make_vision_train_step(model, tx)
 
     rng = np.random.default_rng(jax.process_index())
     data = NamedSharding(mesh, P(("dp", "fsdp")))

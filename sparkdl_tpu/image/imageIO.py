@@ -121,12 +121,15 @@ def readImages(
     numPartition: int | None = None,
     dataframe_backend: str = "local",
 ):
-    """Read images with the default PIL decoder (BGR structs).
+    """Read images with the default decoder (BGR structs): native
+    libjpeg/libpng when the library is available, PIL otherwise — same
+    structs either way (:func:`native_decode_bytes` defers to PIL for
+    anything the native path would represent differently).
 
     Parity with the reference's ``imageIO.readImages`` / Spark's
     ``ImageSchema.readImages``."""
     return readImagesWithCustomFn(
-        path, PIL_decode_bytes, numPartition, dataframe_backend
+        path, native_decode_bytes, numPartition, dataframe_backend
     )
 
 

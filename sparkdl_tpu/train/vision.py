@@ -1,0 +1,53 @@
+"""Train-step builder for the CNN zoo (models with BatchNorm state).
+
+The zoo models return ``(features, probs)`` and carry a ``batch_stats``
+collection; their supervised train step therefore differs from the
+stateless-encoder step in :mod:`finetune` (mutable batch_stats threaded
+through, loss from probabilities). One definition here serves the
+HorovodRunner-parity workload everywhere — the training benchmark, the
+distributed example, and the driver dry-run all jit this same step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def vision_loss_fn(model) -> Callable:
+    """Cross-entropy loss over a zoo model's ``(features, probs)`` output;
+    returns ``(loss, new_batch_stats)``."""
+
+    def loss_fn(params, batch_stats, x, y):
+        (_, probs), updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            x, train=True, mutable=["batch_stats"],
+        )
+        logp = jnp.log(jnp.clip(probs, 1e-8))
+        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+        return loss, updates["batch_stats"]
+
+    return loss_fn
+
+
+def make_vision_train_step(model, tx: optax.GradientTransformation,
+                           *, donate: bool = False) -> Callable:
+    """Jitted ``step(params, batch_stats, opt_state, x, y) ->
+    (params, batch_stats, opt_state, loss)`` for a BatchNorm CNN.
+
+    ``donate=True`` donates the state arguments (benchmark/steady-state
+    loops where the caller always rebinds them).
+    """
+    loss_fn = vision_loss_fn(model)
+
+    def step(params: Any, batch_stats: Any, opt_state: Any, x, y):
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, batch_stats, x, y)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_stats, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())

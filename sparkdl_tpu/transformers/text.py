@@ -42,20 +42,27 @@ _POOLINGS = ("cls", "mean", "pooler")
 #: model arrives as live arrays, so the stable cross-deserialization key is
 #: a content fingerprint).
 _RUNNER_CACHE: dict = {}
-_FINGERPRINTS: dict = {}  # id(variables) -> digest (valid while referenced)
+#: (id(variables), cheap probe) -> full digest. The probe (leaf count +
+#: total bytes + first-leaf prefix) guards against id() reuse after the
+#: original pytree is garbage-collected — a bare id key could hand a new
+#: model another model's fingerprint.
+_FINGERPRINTS: dict = {}
 
 
 def _fingerprint(variables) -> str:
     import jax
 
-    key = id(variables)
+    leaves = sorted(
+        jax.tree_util.tree_flatten_with_path(variables)[0],
+        key=lambda kv: str(kv[0]),
+    )
+    first = np.asarray(leaves[0][1]).reshape(-1)[:16].tobytes() if leaves else b""
+    total = sum(np.asarray(l).nbytes for _, l in leaves)
+    key = (id(variables), len(leaves), total, first)
     fp = _FINGERPRINTS.get(key)
     if fp is None:
         h = hashlib.blake2b(digest_size=16)
-        for path, leaf in sorted(
-            jax.tree_util.tree_flatten_with_path(variables)[0],
-            key=lambda kv: str(kv[0]),
-        ):
+        for path, leaf in leaves:
             h.update(str(path).encode())
             h.update(np.asarray(leaf).tobytes())
         fp = h.hexdigest()
