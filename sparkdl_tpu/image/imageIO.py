@@ -106,7 +106,8 @@ def native_decode_bytes(raw: bytes, origin: str = "") -> dict | None:
     if _native_decode.available():
         info = _native_decode.image_info(raw)
         if info is not None and info[2] == 3:
-            arr = _native_decode.decode_resize(raw)
+            # Pass the probed dims: skips a second header parse + copy.
+            arr = _native_decode.decode_resize(raw, info[0], info[1])
             if arr is not None:
                 return imageArrayToStructBGR(arr, origin)
     return PIL_decode_bytes(raw, origin)

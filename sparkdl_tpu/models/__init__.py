@@ -9,8 +9,10 @@ from sparkdl_tpu.models.registry import (
 from sparkdl_tpu.models.gpt import (
     GPTConfig,
     GPTLMHeadModel,
+    config_from_hf_gpt2,
     generate,
     init_cache,
+    load_hf_gpt2,
 )
 from sparkdl_tpu.models.bert import (
     BertConfig,
@@ -29,8 +31,10 @@ __all__ = [
     "registry",
     "GPTConfig",
     "GPTLMHeadModel",
+    "config_from_hf_gpt2",
     "generate",
     "init_cache",
+    "load_hf_gpt2",
     "BertConfig",
     "BertForSequenceClassification",
     "BertModel",

@@ -48,6 +48,9 @@ class GPTConfig:
     num_heads: int = 12
     intermediate_size: int = 3072
     max_seq_len: int = 1024
+    #: "rope" (default) or "learned" (GPT-2-style position table — required
+    #: for HF GPT-2 weight fidelity, see :func:`load_hf_gpt2`)
+    positions: str = "rope"
     rope_base: float = 10000.0
     layer_norm_eps: float = 1e-5
     dropout: float = 0.0
@@ -117,11 +120,12 @@ class GPTAttention(nn.Module):
         q, k, v = (t.reshape(b, l, nh, hd) for t in (q, k, v))
 
         idx = cache["idx"] if cache is not None else jnp.zeros((), jnp.int32)
-        if positions is None:
-            positions = idx + jnp.arange(l)[None, :]  # [1, L] -> broadcast
-            positions = jnp.broadcast_to(positions, (b, l))
-        q = apply_rope(q, positions, c.rope_base)
-        k = apply_rope(k, positions, c.rope_base)
+        if c.positions == "rope":
+            if positions is None:
+                positions = idx + jnp.arange(l)[None, :]  # [1, L] broadcast
+                positions = jnp.broadcast_to(positions, (b, l))
+            q = apply_rope(q, positions, c.rope_base)
+            k = apply_rope(k, positions, c.rope_base)
 
         if cache is not None:
             # Write this call's keys/values at [idx, idx+L), then attend
@@ -243,6 +247,14 @@ class GPTLMHeadModel(nn.Module):
         wte = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
                        name="wte")
         x = wte(input_ids)
+        if c.positions == "learned":
+            b, l = input_ids.shape
+            idx = cache["idx"] if cache is not None else jnp.zeros((), jnp.int32)
+            pos = positions
+            if pos is None:
+                pos = jnp.broadcast_to(idx + jnp.arange(l)[None, :], (b, l))
+            x = x + nn.Embed(c.max_seq_len, c.hidden_size, dtype=c.dtype,
+                             name="wpe")(pos)
         x = nn.Dropout(c.dropout, deterministic=not train)(x)
 
         new_ks, new_vs = [], []
@@ -265,6 +277,92 @@ class GPTLMHeadModel(nn.Module):
                 "idx": cache["idx"] + input_ids.shape[1],
             }
         return logits, cache
+
+
+# ---------------------------------------------------------------------------
+# HuggingFace GPT-2 weight conversion (torch state dict -> this pytree)
+# ---------------------------------------------------------------------------
+
+def config_from_hf_gpt2(hf_config) -> GPTConfig:
+    """GPTConfig reproducing an HF ``GPT2Config`` (learned positions,
+    tanh-gelu MLP — both already this module's conventions). Variants this
+    forward cannot reproduce are rejected rather than silently diverging."""
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(
+            f"unsupported GPT-2 activation {act!r}: this forward uses "
+            "tanh-gelu (gelu_new)"
+        )
+    if not getattr(hf_config, "scale_attn_weights", True) or getattr(
+        hf_config, "scale_attn_by_inverse_layer_idx", False
+    ):
+        raise ValueError(
+            "unsupported GPT-2 attention scaling variant (requires "
+            "scale_attn_weights=True, scale_attn_by_inverse_layer_idx=False)"
+        )
+    return GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.n_embd,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
+        max_seq_len=hf_config.n_positions,
+        positions="learned",
+        layer_norm_eps=hf_config.layer_norm_epsilon,
+        dropout=0.0,
+    )
+
+
+def load_hf_gpt2(hf_model) -> "tuple[GPTConfig, dict]":
+    """Convert an HF ``GPT2Model``/``GPT2LMHeadModel`` (torch) into this
+    module's (config, variables). GPT-2's Conv1D stores weights [in, out],
+    the same layout as flax Dense kernels — no transposes; the fused
+    c_attn splits into q/k/v. Oracle-tested: logits match the torch
+    forward on the same tokens (tests/models/test_gpt.py)."""
+    import numpy as np
+
+    base = getattr(hf_model, "transformer", hf_model)  # LMHead or bare
+    cfg = config_from_hf_gpt2(base.config)
+    e = cfg.hidden_size
+
+    def _np(t):
+        return np.asarray(t.detach().cpu().numpy())
+
+    def _ln(mod):
+        return {"scale": _np(mod.weight), "bias": _np(mod.bias)}
+
+    params: dict = {
+        "wte": {"embedding": _np(base.wte.weight)},
+        "wpe": {"embedding": _np(base.wpe.weight)},
+        "ln_f": _ln(base.ln_f),
+    }
+    for i, blk in enumerate(base.h):
+        w = _np(blk.attn.c_attn.weight)  # [E, 3E]
+        bias = _np(blk.attn.c_attn.bias)  # [3E]
+        qw, kw, vw = w[:, :e], w[:, e:2 * e], w[:, 2 * e:]
+        qb, kb, vb = bias[:e], bias[e:2 * e], bias[2 * e:]
+        params[f"h_{i}"] = {
+            "ln_1": _ln(blk.ln_1),
+            "ln_2": _ln(blk.ln_2),
+            "attn": {
+                "q_proj": {"kernel": qw, "bias": qb},
+                "k_proj": {"kernel": kw, "bias": kb},
+                "v_proj": {"kernel": vw, "bias": vb},
+                "out_proj": {
+                    "kernel": _np(blk.attn.c_proj.weight),
+                    "bias": _np(blk.attn.c_proj.bias),
+                },
+            },
+            "up": {
+                "kernel": _np(blk.mlp.c_fc.weight),
+                "bias": _np(blk.mlp.c_fc.bias),
+            },
+            "down": {
+                "kernel": _np(blk.mlp.c_proj.weight),
+                "bias": _np(blk.mlp.c_proj.bias),
+            },
+        }
+    return cfg, {"params": params}
 
 
 def generate(
@@ -290,6 +388,14 @@ def generate(
         raise ValueError(
             f"max_len={max_len} < prompt_len {lp} + max_new_tokens "
             f"{max_new_tokens}: cache writes would silently clamp"
+        )
+    if (model.config.positions == "learned"
+            and lp + max_new_tokens > model.config.max_seq_len):
+        # RoPE extrapolates; a learned position table does not — lookups
+        # past it would silently clamp to the last row.
+        raise ValueError(
+            f"prompt_len {lp} + max_new_tokens {max_new_tokens} exceeds the "
+            f"learned position table (max_seq_len={model.config.max_seq_len})"
         )
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature>0) requires rng")

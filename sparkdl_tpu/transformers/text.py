@@ -56,8 +56,12 @@ def _fingerprint(variables) -> str:
         jax.tree_util.tree_flatten_with_path(variables)[0],
         key=lambda kv: str(kv[0]),
     )
-    first = np.asarray(leaves[0][1]).reshape(-1)[:16].tobytes() if leaves else b""
-    total = sum(np.asarray(l).nbytes for _, l in leaves)
+    # Probe without device->host copies: nbytes is metadata, and the first
+    # leaf is sliced on-device before the 16-element transfer.
+    first = (
+        np.asarray(leaves[0][1].reshape(-1)[:16]).tobytes() if leaves else b""
+    )
+    total = sum(l.nbytes for _, l in leaves)
     key = (id(variables), len(leaves), total, first)
     fp = _FINGERPRINTS.get(key)
     if fp is None:

@@ -168,6 +168,33 @@ def test_tp_sharded_matches_unsharded(tiny):
     )
 
 
+def test_hf_gpt2_weight_fidelity():
+    """Converted HF GPT-2 weights: our forward == the torch forward."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from sparkdl_tpu.models.gpt import load_hf_gpt2
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=16, n_layer=2, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    cfg, variables = load_hf_gpt2(hf)
+    model = GPTLMHeadModel(cfg)
+
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 96, (2, 10))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got, _ = model.apply(variables, jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
+
+    # KV-cached greedy generation works on the converted weights too.
+    out = generate(model, variables, jnp.asarray(ids[:, :4], jnp.int32), 4)
+    assert out.shape == (2, 8)
+
+
 def test_moe_gpt_forward_backward():
     cfg = GPTConfig.tiny(num_experts=4, moe_every=2)
     model = GPTLMHeadModel(cfg)
