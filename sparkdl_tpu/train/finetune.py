@@ -77,6 +77,7 @@ def finetune_classifier(
     *,
     learning_rate: float = 2e-5,
     weight_decay: float = 0.01,
+    tx: "optax.GradientTransformation | None" = None,
     mesh: Mesh | None = None,
     metrics_cb: Callable[[dict], None] | None = None,
     checkpoint_dir: "str | None" = None,
@@ -89,6 +90,11 @@ def finetune_classifier(
     axes before the jitted step — under TPURunner each process feeds its
     local shard of the global batch.
 
+    ``tx`` overrides the default ``adamw(learning_rate, weight_decay)``
+    optimizer — pass any optax chain (warmup/cosine schedules,
+    ``optax.MultiSteps`` gradient accumulation, clipping, ...) without
+    forking the loop.
+
     With ``checkpoint_dir`` set, the full train state is async-saved every
     ``checkpoint_every`` steps plus once at the end, and an existing
     checkpoint in that directory is resumed from (already-trained steps are
@@ -96,7 +102,8 @@ def finetune_classifier(
     """
     if mesh is None:
         mesh = data_parallel_mesh()
-    tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+    if tx is None:
+        tx = optax.adamw(learning_rate, weight_decay=weight_decay)
     step = jax.jit(classification_train_step(apply_fn, tx))
 
     data_sharding = NamedSharding(mesh, P(("dp", "fsdp")))

@@ -50,3 +50,46 @@ def test_batches_from_arrays_shapes():
     batches = list(batches_from_arrays(arrays, 4, epochs=2))
     assert len(batches) == 4  # 2 per epoch, remainder dropped
     assert all(len(b["x"]) == 4 for b in batches)
+
+
+def test_custom_optimizer_with_schedule_and_accumulation():
+    """tx override: warmup-cosine schedule wrapped in MultiSteps gradient
+    accumulation runs through the same loop and still learns."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from sparkdl_tpu.models.bert import BertConfig, BertForSequenceClassification
+    from sparkdl_tpu.train.finetune import batches_from_arrays, finetune_classifier
+
+    cfg = BertConfig.tiny(vocab_size=32)
+    model = BertForSequenceClassification(cfg, num_labels=2)
+    rng = np.random.default_rng(0)
+    n, l = 48, 8
+    ids = rng.integers(0, 32, (n, l)).astype(np.int32)
+    labels = (ids[:, 0] >= 16).astype(np.int32)
+    data = {
+        "input_ids": ids,
+        "attention_mask": np.ones((n, l), np.int32),
+        "labels": labels,
+    }
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.asarray(ids[:1]),
+        jnp.ones((1, l), jnp.int32),
+    )
+
+    sched = optax.warmup_cosine_decay_schedule(0.0, 5e-3, 4, 40)
+    tx = optax.MultiSteps(optax.adamw(sched), every_k_schedule=2)
+    _, history = finetune_classifier(
+        lambda p, input_ids, attention_mask: model.apply(
+            p, input_ids, attention_mask
+        ),
+        params,
+        batches_from_arrays(data, 16, epochs=6),
+        tx=tx,
+    )
+    assert history
+    first = np.mean([h["loss"] for h in history[:3]])
+    last = np.mean([h["loss"] for h in history[-3:]])
+    assert last < first
