@@ -14,7 +14,7 @@ toolchain is present.
 """
 
 from sparkdl_tpu.native._lib import available
-from sparkdl_tpu.native import decode
+from sparkdl_tpu.native import arrow, decode
 from sparkdl_tpu.native.bridge import (
     DeviceFeeder,
     StagingRing,
@@ -22,5 +22,5 @@ from sparkdl_tpu.native.bridge import (
     u8_to_f32,
 )
 
-__all__ = ["available", "decode", "DeviceFeeder", "StagingRing", "pack_rows",
+__all__ = ["available", "arrow", "decode", "DeviceFeeder", "StagingRing", "pack_rows",
            "u8_to_f32"]

@@ -1,0 +1,108 @@
+"""Arrow adapters: zero-copy column views, ragged packing vs oracle,
+null rejection."""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from sparkdl_tpu.native.arrow import (  # noqa: E402
+    column_matrix,
+    column_rows,
+    pack_arrow_column,
+)
+from sparkdl_tpu.native.bridge import pack_rows  # noqa: E402
+
+
+@pytest.fixture()
+def fixed_batch():
+    data = np.arange(24, dtype=np.float32).reshape(6, 4)
+    arr = pa.FixedSizeListArray.from_arrays(pa.array(data.reshape(-1)), 4)
+    return pa.RecordBatch.from_arrays([arr], ["feat"]), data
+
+
+def test_fixed_size_list_matrix_zero_copy(fixed_batch):
+    batch, data = fixed_batch
+    m = column_matrix(batch, "feat")
+    np.testing.assert_array_equal(m, data)
+    # zero-copy: the numpy view aliases Arrow's buffer, not a fresh copy
+    buf_addr = batch.column("feat").values.buffers()[1].address
+    assert m.ctypes.data == buf_addr
+
+
+def test_fixed_size_list_with_batch_slice(fixed_batch):
+    batch, data = fixed_batch
+    sliced = batch.slice(2, 3)
+    np.testing.assert_array_equal(column_matrix(sliced, "feat"), data[2:5])
+
+
+def test_primitive_column_matrix():
+    batch = pa.RecordBatch.from_arrays(
+        [pa.array(np.asarray([1.5, 2.5, 3.5], np.float64))], ["x"]
+    )
+    m = column_matrix(batch, "x")
+    assert m.shape == (3, 1) and m[1, 0] == 2.5
+
+
+def test_ragged_rows_and_pack_match_oracle():
+    rows_np = [
+        np.arange(3, dtype=np.float32),
+        np.arange(5, dtype=np.float32) * 2,
+        np.arange(1, dtype=np.float32) + 7,
+    ]
+    arr = pa.array([r.tolist() for r in rows_np], pa.list_(pa.float32()))
+    batch = pa.RecordBatch.from_arrays([arr], ["feat"])
+
+    got_rows = column_rows(batch, "feat")
+    for g, w in zip(got_rows, rows_np):
+        np.testing.assert_array_equal(g, w)
+
+    packed, n, stride = pack_arrow_column(batch, "feat", bucket=4)
+    want = pack_rows(rows_np, bucket=4, row_stride=stride)
+    np.testing.assert_array_equal(packed, want)
+    assert n == 3
+
+
+def test_ragged_rows_with_batch_slice():
+    rows_np = [
+        np.arange(3, dtype=np.float32),
+        np.arange(5, dtype=np.float32) * 2,
+        np.arange(1, dtype=np.float32) + 7,
+        np.arange(2, dtype=np.float32) - 1,
+    ]
+    arr = pa.array([r.tolist() for r in rows_np], pa.list_(pa.float32()))
+    batch = pa.RecordBatch.from_arrays([arr], ["feat"]).slice(1, 2)
+    got = column_rows(batch, "feat")
+    assert len(got) == 2
+    np.testing.assert_array_equal(got[0], rows_np[1])
+    np.testing.assert_array_equal(got[1], rows_np[2])
+
+
+def test_fixed_size_slice_ignores_nulls_outside_window():
+    arr = pa.array([None, [1.0, 2.0], [3.0, 4.0]], pa.list_(pa.float32(), 2))
+    batch = pa.RecordBatch.from_arrays([arr], ["f"]).slice(1, 2)
+    m = column_matrix(batch, "f")
+    np.testing.assert_array_equal(m, [[1.0, 2.0], [3.0, 4.0]])
+
+
+def test_fixed_pack_fast_path_matches_pack_rows(fixed_batch):
+    batch, data = fixed_batch
+    packed, n, stride = pack_arrow_column(batch, "feat", bucket=8)
+    want = pack_rows([data[i] for i in range(len(data))], bucket=8,
+                     row_stride=stride)
+    np.testing.assert_array_equal(packed, want)
+    assert n == len(data) and stride == 16
+
+
+def test_ragged_matrix_rejected():
+    arr = pa.array([[1.0], [2.0, 3.0]], pa.list_(pa.float32()))
+    batch = pa.RecordBatch.from_arrays([arr], ["f"])
+    with pytest.raises(ValueError, match="variable-length"):
+        column_matrix(batch, "f")
+
+
+def test_nulls_rejected():
+    arr = pa.array([[1.0, 2.0], None], pa.list_(pa.float32()))
+    batch = pa.RecordBatch.from_arrays([arr], ["f"])
+    with pytest.raises(ValueError, match="null"):
+        column_rows(batch, "f")
