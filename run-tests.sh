@@ -9,7 +9,7 @@ cd "$(dirname "$0")"
 python -m pytest tests/ -q "$@"
 
 # Driver-contract smoke: bench prints exactly one JSON line; graft hooks
-# compile entry() and run the 5-regime multichip dryrun.
+# compile entry() and run the 6-regime multichip dryrun.
 JAX_PLATFORMS=cpu BENCH_STEPS=2 BENCH_BATCH=4 python bench.py | tail -1 | python -c '
 import json, sys
 line = sys.stdin.readline()
@@ -17,4 +17,9 @@ rec = json.loads(line)
 assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
 print("bench.py contract OK")
 '
-python __graft_entry__.py
+# The driver's EXACT call form: import the module, call dryrun_multichip(8)
+# with however many devices this host exposes (1 here — JAX_PLATFORMS=cpu
+# without a forced device count), so the self-provisioning re-exec path is
+# what gets tested, not an env-prepared shortcut.
+JAX_PLATFORMS=cpu python -c 'import __graft_entry__ as g; g.dryrun_multichip(8)'
+SDL_SKIP_DRYRUN=1 python __graft_entry__.py

@@ -32,6 +32,25 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def virtual_cpu_overrides(n_devices: int, existing_flags: str = "") -> dict:
+    """Env overrides forcing an ``n_devices``-way virtual CPU platform.
+
+    The single source of truth for the "fake mesh" env contract used by the
+    test conftest, LocalProcessBackend children, and the graft-entry
+    dry-run re-exec: ``JAX_PLATFORMS=cpu`` plus
+    ``--xla_force_host_platform_device_count`` (any existing count flag in
+    ``existing_flags`` is replaced, not duplicated). Overrides must be in
+    place before the target process initializes a jax backend.
+    """
+    flags = [
+        f
+        for f in existing_flags.split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    return {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": " ".join(flags)}
+
+
 class LocalProcessBackend:
     """Run n ranks as subprocesses of this host (HorovodRunner np<0 mode).
 
@@ -52,13 +71,12 @@ class LocalProcessBackend:
         import cloudpickle
 
         env_overrides = {}
-        if self.platform:
-            env_overrides["JAX_PLATFORMS"] = self.platform
         if self.platform == "cpu" and self.devices_per_process > 1:
-            env_overrides["XLA_FLAGS"] = (
-                os.environ.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={self.devices_per_process}"
-            ).strip()
+            env_overrides = virtual_cpu_overrides(
+                self.devices_per_process, os.environ.get("XLA_FLAGS", "")
+            )
+        elif self.platform:
+            env_overrides["JAX_PLATFORMS"] = self.platform
 
         workdir = tempfile.mkdtemp(prefix="sparkdl_tpu_run_")
         payload_path = os.path.join(workdir, "payload.pkl")

@@ -9,12 +9,11 @@ a backend, hence top of conftest.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Single source of truth for the fake-mesh env contract (stdlib-only import
+# chain, so no jax backend is touched here).
+from sparkdl_tpu.runner.backends import virtual_cpu_overrides
+
+os.environ.update(virtual_cpu_overrides(8, os.environ.get("XLA_FLAGS", "")))
 # Keep TF (used only for ingestion tests) off any accelerator and quiet.
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
 os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
