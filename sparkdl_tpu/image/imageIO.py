@@ -106,7 +106,7 @@ def native_decode_bytes(raw: bytes, origin: str = "") -> dict | None:
     if _native_decode.available():
         info = _native_decode.image_info(raw)
         if info is not None and info[2] == 3:
-            # Pass the probed dims: skips a second header parse + copy.
+            # Pass the probed dims: skips a second header parse.
             arr = _native_decode.decode_resize(raw, info[0], info[1])
             if arr is not None:
                 return imageArrayToStructBGR(arr, origin)

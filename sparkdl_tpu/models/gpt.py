@@ -54,7 +54,9 @@ class GPTConfig:
     rope_base: float = 10000.0
     layer_norm_eps: float = 1e-5
     dropout: float = 0.0
-    #: "full" | "flash" (Pallas fused kernel) | "ring" (sp-sharded)
+    #: "full" | "flash" (Pallas fused kernel) | "ring" (sp-sharded).
+    #: Applies to the UNCACHED forward only: KV-cached decode always takes
+    #: the dense masked path over the cache buffer regardless of this knob.
     attn_impl: str = "full"
     sp_axis: str = "sp"
     #: 0 = dense MLPs; >0 = MoE with this many experts

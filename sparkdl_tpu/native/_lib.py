@@ -151,6 +151,7 @@ def _declare_decode(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.POINTER(c.c_uint8), c.c_uint64, c.c_int32, c.c_int32,
         c.POINTER(c.c_uint8),
     ]
+    lib.sdl_decode_resize_batch.restype = None
     lib.sdl_decode_resize_batch.argtypes = [
         c.c_uint64, c.POINTER(c.POINTER(c.c_uint8)),
         c.POINTER(c.c_uint64), c.c_int32, c.c_int32,

@@ -17,6 +17,7 @@ yields int ids, e.g. ``transformers.AutoTokenizer``.
 from __future__ import annotations
 
 import hashlib
+from collections import OrderedDict
 
 import numpy as np
 
@@ -36,17 +37,39 @@ from sparkdl_tpu.transformers._inference import (
 
 _POOLINGS = ("cls", "mean", "pooler")
 
+class _LruCache(OrderedDict):
+    """Tiny bounded LRU so long-lived executors hosting many models don't
+    accumulate jitted programs / weight digests for the process lifetime."""
+
+    def __init__(self, maxsize: int):
+        super().__init__()
+        self.maxsize = maxsize
+
+    def get(self, key, default=None):
+        if key in self:
+            self.move_to_end(key)
+            return self[key]
+        return default
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        while len(self) > self.maxsize:
+            self.popitem(last=False)
+
+
 #: per-process runner cache: one jitted BERT forward per (weights, config,
 #: pooling, shapes) no matter how many partitions/tasks deserialize the
 #: transformer (the sibling transformers key by model *file path*; here the
 #: model arrives as live arrays, so the stable cross-deserialization key is
-#: a content fingerprint).
-_RUNNER_CACHE: dict = {}
+#: a content fingerprint). LRU-bounded: evicting a live runner only costs a
+#: re-jit on next use.
+_RUNNER_CACHE: _LruCache = _LruCache(maxsize=8)
 #: (id(variables), cheap probe) -> full digest. The probe (leaf count +
 #: total bytes + first-leaf prefix) guards against id() reuse after the
 #: original pytree is garbage-collected — a bare id key could hand a new
 #: model another model's fingerprint.
-_FINGERPRINTS: dict = {}
+_FINGERPRINTS: _LruCache = _LruCache(maxsize=64)
 
 
 def _fingerprint(variables) -> str:
