@@ -182,14 +182,290 @@ def _load_result(result_path: str) -> Any:
     return value
 
 
+def _host_sort_key(hostname: str) -> tuple:
+    """Natural sort key: digit runs compare numerically.
+
+    TPU-VM worker hostnames carry the worker index as a trailing integer
+    (``...-w-0``, ``...-w-1``, ... ``...-w-10``); natural order makes
+    rank assignment follow the TPU process topology, and plain string sort
+    would put ``-w-10`` before ``-w-2``.
+    """
+    import re
+
+    return tuple(
+        int(part) if part.isdigit() else part
+        for part in re.split(r"(\d+)", hostname)
+    )
+
+
+def resolve_ranks(addrs: list[str]) -> tuple[list[int], str]:
+    """Map barrier-task rendezvous addresses to stable JAX process ids.
+
+    ``addrs[i]`` is partition *i*'s ``host:port``. Returns
+    ``(rank_of_partition, coordinator_address)`` where
+    ``rank_of_partition[i]`` is the jax process_id partition *i* must use.
+
+    Ranks are assigned by natural-sorted hostname, NOT by Spark partition
+    id (SURVEY.md §7 hard part 2): a barrier stage retry may land
+    partitions on different executors, but a given TPU host always
+    resolves to the same rank as long as the host set is unchanged — so
+    rank↔chip binding (and any rank-keyed checkpoint state) survives
+    retries. The coordinator is whichever host sorts first.
+
+    Exactly one task per host is enforced here: two barrier tasks on one
+    host would each grab the host's TPU runtime and deadlock it. The fix
+    on a real cluster is one executor per TPU host (spark.task.cpus =
+    executor cores, or spark.executor.cores tuned so one slot per host).
+    """
+    hosts = [a.rsplit(":", 1)[0] for a in addrs]
+    dupes = sorted({h for h in hosts if hosts.count(h) > 1})
+    if dupes:
+        raise RuntimeError(
+            f"barrier placement error: multiple tasks on host(s) "
+            f"{', '.join(dupes)} — TPURunner needs exactly one barrier "
+            f"task per TPU host (set spark.task.cpus == executor cores so "
+            f"each executor runs one task, one executor per host)"
+        )
+    order = sorted(range(len(addrs)), key=lambda i: _host_sort_key(hosts[i]))
+    rank_of_partition = [0] * len(addrs)
+    for rank, part in enumerate(order):
+        rank_of_partition[part] = rank
+    return rank_of_partition, addrs[order[0]]
+
+
+def run_barrier_task(
+    ctx,
+    payload: bytes,
+    nprocs: int,
+    preflight_opts: dict,
+    log_addr: "str | None" = None,
+    hostname: "str | None" = None,
+    distributed_init: "Callable | None" = None,
+) -> bytes:
+    """Body of one Spark barrier task, extracted so a faked
+    BarrierTaskContext (``partitionId()`` + ``allGather(str)``) can drive
+    it in-suite without pyspark (SURVEY.md §4: test semantics locally).
+
+    ``distributed_init(coordinator, nprocs, rank)`` defaults to
+    ``jax.distributed.initialize``; tests inject a recorder. Returns rank
+    0's pickled result (b"" on other ranks).
+    """
+    import cloudpickle
+
+    hostname = hostname or socket.gethostname()
+    port = free_port()
+    addrs = list(ctx.allGather(f"{hostname}:{port}"))
+    if len(addrs) != nprocs:
+        raise RuntimeError(
+            f"rendezvous returned {len(addrs)} addresses for {nprocs} tasks"
+        )
+    rank_of_partition, coordinator = resolve_ranks(addrs)
+    rank = rank_of_partition[ctx.partitionId()]
+
+    with _ShipOutput(log_addr, rank):
+        if distributed_init is None:
+            import jax
+
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=nprocs,
+                    process_id=rank,
+                )
+            except Exception as e:
+                # Most likely cause: the coordinator port advertised at
+                # rendezvous got taken between free_port() and the bind
+                # here. Barrier stages are all-or-nothing — failing the
+                # task makes Spark retry the whole stage, which re-runs
+                # the rendezvous with a fresh port.
+                raise RuntimeError(
+                    f"jax.distributed.initialize failed on rank {rank} "
+                    f"(coordinator {coordinator}): {e}. If this is a port "
+                    f"collision the stage retry re-rendezvouses cleanly."
+                ) from e
+        else:
+            distributed_init(coordinator, nprocs, rank)
+        # Slice health probe before the user fn compiles anything: a bad
+        # chip fails this barrier task now, and Spark's stage retry plus
+        # checkpoint resume (sparkdl_tpu.checkpoint) handle the rest.
+        from sparkdl_tpu.observability.health import preflight
+
+        preflight(rank=rank, **preflight_opts)
+        p = cloudpickle.loads(payload)
+        out = p["fn"](**p["kwargs"])
+    return pickle.dumps(out) if rank == 0 else b""
+
+
+def _get_barrier_context():
+    """Executor-side hook returning the live barrier context; module-level
+    so suites without pyspark can monkeypatch a fake in under the REAL
+    ``SparkBarrierBackend.run`` body."""
+    from pyspark import BarrierTaskContext
+
+    return BarrierTaskContext.get()
+
+
+class _LogRelay:
+    """Driver-side TCP line sink for executor stdout (HorovodRunner's
+    ``driver_log_verbosity`` equivalent, SURVEY.md 2.13).
+
+    Executors already need driver connectivity in Spark (block manager,
+    barrier coordination), so a plain listening socket on the driver is
+    reachable wherever Spark itself works. Each task connects once and
+    streams ``[rank N] ...`` lines; the relay prints them into the driver
+    log as they arrive.
+    """
+
+    def __init__(self, sink: "Callable[[str], None] | None" = None,
+                 keep_lines: int = 10_000):
+        import collections
+
+        self._sink = sink or (lambda line: print(line, flush=True))
+        #: bounded tail of forwarded lines (test/inspection hook; the full
+        #: stream goes to the sink) — unbounded would leak driver memory
+        #: over a long job's worth of executor output.
+        self.lines: "collections.deque[str]" = collections.deque(
+            maxlen=keep_lines)
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("", 0))
+        self._srv.listen(64)
+        self._srv.settimeout(0.2)
+        self.address = f"{socket.gethostname()}:{self._srv.getsockname()[1]}"
+        self._closing = threading.Event()
+        self._threads: list[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self) -> None:
+        while not self._closing.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._pump, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _pump(self, conn: socket.socket) -> None:
+        with conn, conn.makefile("r", errors="replace") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                self.lines.append(line)
+                self._sink(line)
+
+    def close(self) -> None:
+        self._closing.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=2)
+
+
+class _ShipOutput:
+    """Executor-side context manager: tee this process's stdout/stderr to
+    the driver's :class:`_LogRelay` while the user fn runs.
+
+    File-descriptor level (dup2), so native prints (XLA, C++ bridge) ship
+    too, not just Python ``print``. Lines still reach the executor's own
+    log via the tee. No-op when ``addr`` is None (verbosity 'none') or the
+    relay is unreachable — log forwarding must never fail the job.
+    """
+
+    def __init__(self, addr: "str | None", rank: int):
+        self.addr = addr
+        self.rank = rank
+        self._sock = None
+        self._saved: list[tuple[int, int]] = []
+        self._pump_thread = None
+
+    def __enter__(self):
+        if self.addr is None:
+            return self
+        try:
+            host, port = self.addr.rsplit(":", 1)
+            self._sock = socket.create_connection((host, int(port)), timeout=5)
+        except OSError:
+            self._sock = None
+            return self
+        r, w = os.pipe()
+        self._saved = [(1, os.dup(1)), (2, os.dup(2))]
+        os.dup2(w, 1)
+        os.dup2(w, 2)
+        os.close(w)
+        self._pump_thread = threading.Thread(
+            target=self._pump, args=(r,), daemon=True
+        )
+        self._pump_thread.start()
+        return self
+
+    def _pump(self, rfd: int) -> None:
+        orig_out = self._saved[0][1]
+        buf = b""
+        with os.fdopen(rfd, "rb", closefd=True) as r:
+            while True:
+                chunk = r.read1(65536)
+                if not chunk:
+                    break
+                os.write(orig_out, chunk)  # tee to the executor's own log
+                buf += chunk
+                *lines, buf = buf.split(b"\n")
+                for line in lines:
+                    self._send(line)
+        if buf:
+            self._send(buf)
+
+    def _send(self, line: bytes) -> None:
+        if self._sock is None:
+            return
+        try:
+            self._sock.sendall(b"[rank %d] %s\n" % (self.rank, line))
+        except OSError:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def __exit__(self, *exc):
+        if not self._saved:
+            if self._sock is not None:
+                self._sock.close()
+            return False
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # Restore first: dropping the last write-end refs of the pipe EOFs
+        # the pump; only close the saved duplicates after the pump (which
+        # tees through one of them) has drained.
+        for fd, saved in self._saved:
+            os.dup2(saved, fd)
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=5)
+        for _, saved in self._saved:
+            os.close(saved)
+        self._saved = []
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+        return False
+
+
 class SparkBarrierBackend:
     """np>0 mode: one barrier task per TPU host via a live SparkSession.
 
-    The task body rendezvouses through ``BarrierTaskContext.allGather``
-    (rank 0 publishes ``host:port``), calls ``jax.distributed.initialize``
-    with that coordinator, runs the user fn, and returns rank 0's result to
-    the driver — the reference's mpirun bootstrap replaced by coordinator
-    address exchange (SURVEY.md §5 "Distributed communication backend").
+    The task body (:func:`run_barrier_task`) rendezvouses through
+    ``BarrierTaskContext.allGather`` (each task publishes ``host:port``),
+    resolves stable hostname-ordered ranks, calls
+    ``jax.distributed.initialize`` with the coordinator, runs the user fn
+    with stdout teed to the driver, and returns rank 0's result — the
+    reference's mpirun bootstrap replaced by coordinator address exchange
+    (SURVEY.md §5 "Distributed communication backend").
     """
 
     def __init__(self, spark_session=None):
@@ -215,37 +491,26 @@ class SparkBarrierBackend:
         from sparkdl_tpu.observability.health import preflight_env_opts
 
         preflight_opts = preflight_env_opts()
+        relay = _LogRelay() if verbosity == "all" else None
+        log_addr = relay.address if relay is not None else None
 
         def barrier_task(it):
-            from pyspark import BarrierTaskContext
-
-            ctx = BarrierTaskContext.get()
-            rank = ctx.partitionId()
-            port = free_port()
-            addrs = ctx.allGather(f"{socket.gethostname()}:{port}")
-            coordinator = addrs[0]
-
-            import jax
-
-            jax.distributed.initialize(
-                coordinator_address=coordinator,
-                num_processes=nprocs,
-                process_id=rank,
+            ctx = _get_barrier_context()
+            yield run_barrier_task(
+                ctx, payload, nprocs, preflight_opts, log_addr=log_addr
             )
-            # Slice health probe before the user fn compiles anything: a bad
-            # chip fails this barrier task now, and Spark's stage retry plus
-            # checkpoint resume (sparkdl_tpu.checkpoint) handle the rest.
-            from sparkdl_tpu.observability.health import preflight
 
-            preflight(rank=rank, **preflight_opts)
-            p = cloudpickle.loads(payload)
-            out = p["fn"](**p["kwargs"])
-            yield pickle.dumps(out) if rank == 0 else b""
-
-        results = (
-            sc.parallelize(range(nprocs), nprocs)
-            .barrier()
-            .mapPartitions(barrier_task)
-            .collect()
-        )
-        return pickle.loads(results[0])
+        try:
+            results = (
+                sc.parallelize(range(nprocs), nprocs)
+                .barrier()
+                .mapPartitions(barrier_task)
+                .collect()
+            )
+        finally:
+            if relay is not None:
+                relay.close()
+        ranked = [r for r in results if r]
+        if not ranked:
+            raise RuntimeError("no rank returned a result")
+        return pickle.loads(ranked[0])

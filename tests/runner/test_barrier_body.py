@@ -1,0 +1,269 @@
+"""SparkBarrierBackend task-body tests without pyspark (SURVEY.md §4:
+distributed semantics tested locally; VERDICT round-1 missing #2/#3/#4).
+
+A faked BarrierTaskContext (threading.Barrier-backed allGather) drives the
+real :func:`run_barrier_task` body across N threads: rendezvous ordering,
+hostname-sorted rank stability, one-task-per-host enforcement, stdout
+forwarding through the driver-side log relay, and rank-0 result plumbing.
+``distributed_init`` is injected so no real jax.distributed job forms.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+import time
+
+import cloudpickle
+import pytest
+
+from sparkdl_tpu.runner.backends import (
+    _LogRelay,
+    _ShipOutput,
+    resolve_ranks,
+    run_barrier_task,
+)
+
+
+class FakeBarrierTaskContext:
+    """allGather + partitionId, semantics-matched to pyspark's barrier ctx:
+    every task must call allGather; messages come back in partition order."""
+
+    def __init__(self, partition_id: int, shared: dict):
+        self._pid = partition_id
+        self._shared = shared
+
+    def partitionId(self) -> int:
+        return self._pid
+
+    def allGather(self, message: str) -> list:
+        self._shared["msgs"][self._pid] = message
+        self._shared["barrier"].wait(timeout=30)
+        return [self._shared["msgs"][i] for i in sorted(self._shared["msgs"])]
+
+
+def _drive(nprocs, fn, kwargs=None, hostnames=None, log_addr=None,
+           preflight_opts=None):
+    """Run the real barrier task body on nprocs threads; return
+    (results_by_partition, init_records)."""
+    payload = cloudpickle.dumps({"fn": fn, "kwargs": kwargs or {}})
+    shared = {"msgs": {}, "barrier": threading.Barrier(nprocs)}
+    results: list = [None] * nprocs
+    errors: list = [None] * nprocs
+    records: list = [None] * nprocs
+
+    def make_init(i):
+        def init(coordinator, n, rank):
+            records[i] = (coordinator, n, rank)
+        return init
+
+    def task(i):
+        ctx = FakeBarrierTaskContext(i, shared)
+        try:
+            results[i] = run_barrier_task(
+                ctx, payload, nprocs,
+                preflight_opts if preflight_opts is not None
+                else {"skip": True},
+                log_addr=log_addr,
+                hostname=(hostnames[i] if hostnames else f"fake-w-{i}"),
+                distributed_init=make_init(i),
+            )
+        except BaseException as e:  # noqa: BLE001 - surfaced to the test
+            errors[i] = e
+
+    threads = [threading.Thread(target=task, args=(i,)) for i in range(nprocs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    return results, errors, records
+
+
+def test_rank0_result_comes_back_and_others_empty():
+    results, errors, records = _drive(3, lambda x: {"got": x}, {"x": 7})
+    assert errors == [None, None, None]
+    non_empty = [r for r in results if r]
+    assert len(non_empty) == 1
+    assert pickle.loads(non_empty[0]) == {"got": 7}
+    # every rank initialized against the same coordinator with its own rank
+    coords = {c for c, _, _ in records}
+    assert len(coords) == 1
+    assert sorted(r for _, _, r in records) == [0, 1, 2]
+
+
+def test_ranks_follow_natural_hostname_order_not_partition_order():
+    # partition 0 lands on worker 10, partition 1 on worker 2, partition 2
+    # on worker 0: natural hostname sort puts w-0 < w-2 < w-10, so ranks
+    # must be [2, 1, 0] by partition — and rank 0 (partition 2) returns.
+    hostnames = ["t1v-x-w-10", "t1v-x-w-2", "t1v-x-w-0"]
+    results, errors, records = _drive(
+        3, lambda: "hi", hostnames=hostnames
+    )
+    assert errors == [None, None, None]
+    assert [r for _, _, r in records] == [2, 1, 0]
+    assert results[2] and not results[0] and not results[1]
+    # coordinator is the first host in natural order (w-0 = partition 2)
+    assert all(c.startswith("t1v-x-w-0:") for c, _, _ in records)
+
+
+def test_rank_assignment_stable_across_retry_with_shuffled_partitions():
+    hosts = ["h-3", "h-1", "h-2"]
+    _, _, first = _drive(3, lambda: None, hostnames=hosts)
+    # "stage retry": same hosts, different partition placement
+    shuffled = ["h-2", "h-3", "h-1"]
+    _, _, second = _drive(3, lambda: None, hostnames=shuffled)
+    rank_by_host_1 = {h: r for h, (_, _, r) in zip(hosts, first)}
+    rank_by_host_2 = {h: r for h, (_, _, r) in zip(shuffled, second)}
+    assert rank_by_host_1 == rank_by_host_2
+
+
+def test_duplicate_host_placement_rejected():
+    results, errors, _ = _drive(
+        2, lambda: None, hostnames=["same-host", "same-host"]
+    )
+    assert all(e is not None for e in errors)
+    assert "one barrier task per TPU host" in str(errors[0])
+
+
+def test_resolve_ranks_direct():
+    ranks, coord = resolve_ranks(["b:1", "a:2", "c:3"])
+    assert ranks == [1, 0, 2]
+    assert coord == "a:2"
+
+
+def test_stdout_forwarded_to_driver_relay():
+    # fd-level redirection is process-global, so this drives ONE task (in
+    # production each barrier task is its own executor python worker). The
+    # worker writes straight to fd 1 — the level the tee operates at.
+    captured: list[str] = []
+    relay = _LogRelay(sink=captured.append)
+    try:
+        def chatty():
+            import os as _os
+
+            _os.write(1, b"hello from the worker\n")
+            return 1
+
+        results, errors, _ = _drive(1, chatty, log_addr=relay.address)
+        assert errors == [None]
+        deadline = time.time() + 5
+        while not relay.lines and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        relay.close()
+    tagged = [l for l in relay.lines if "hello from the worker" in l]
+    assert tagged and tagged[0].startswith("[rank 0] ")
+    assert captured == list(relay.lines)
+
+
+def test_ship_output_tags_ranks_sequentially():
+    captured: list[str] = []
+    relay = _LogRelay(sink=captured.append)
+    try:
+        import os as _os
+
+        for rank in (0, 1):
+            with _ShipOutput(relay.address, rank):
+                _os.write(1, f"line from {rank}\n".encode())
+        deadline = time.time() + 5
+        while len(relay.lines) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        relay.close()
+    assert "[rank 0] line from 0" in relay.lines
+    assert "[rank 1] line from 1" in relay.lines
+
+
+def test_verbosity_none_means_no_relay_and_still_works():
+    results, errors, _ = _drive(2, lambda: "quiet", log_addr=None)
+    assert errors == [None, None]
+    assert pickle.loads([r for r in results if r][0]) == "quiet"
+
+
+class FakeSparkSession:
+    """Just enough of SparkSession.sparkContext.parallelize(...).barrier()
+    .mapPartitions(...).collect() to drive the REAL SparkBarrierBackend.run
+    body: each partition's closure runs on its own thread with a
+    FakeBarrierTaskContext patched in via ``_get_barrier_context``."""
+
+    def __init__(self, monkeypatch):
+        self._mp = monkeypatch
+        self.sparkContext = self
+
+    def parallelize(self, data, n):
+        self._n = len(list(data))
+        return self
+
+    def barrier(self):
+        return self
+
+    def mapPartitions(self, f):
+        self._f = f
+        return self
+
+    def collect(self):
+        from sparkdl_tpu.runner import backends
+
+        shared = {"msgs": {}, "barrier": threading.Barrier(self._n)}
+        local = threading.local()
+        self._mp.setattr(
+            backends, "_get_barrier_context", lambda: local.ctx
+        )
+        out: list = [None] * self._n
+        errs: list = [None] * self._n
+
+        def part(i):
+            local.ctx = FakeBarrierTaskContext(i, shared)
+            try:
+                out[i] = list(self._f(iter(())))
+            except BaseException as e:  # noqa: BLE001
+                errs[i] = e
+
+        threads = [
+            threading.Thread(target=part, args=(i,)) for i in range(self._n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        if any(errs):
+            raise next(e for e in errs if e)
+        return [x for chunk in out for x in chunk]
+
+
+def test_spark_backend_run_body_with_fake_session(monkeypatch):
+    """Covers SparkBarrierBackend.run end-to-end minus pyspark itself:
+    payload pickling, relay lifecycle, barrier fan-out, rank-0 result."""
+    from sparkdl_tpu.runner import backends
+
+    # the real body calls jax.distributed.initialize — stub the jax module
+    # it imports lazily by pointing run_barrier_task's default init at a
+    # recorder via monkeypatching the function's caller path
+    inits: list = []
+    real_run = backends.run_barrier_task
+
+    def patched_run(ctx, payload, nprocs, opts, log_addr=None, **kw):
+        return real_run(
+            ctx, payload, nprocs, {"skip": True}, log_addr=log_addr,
+            hostname=f"fake-host-{ctx.partitionId()}",
+            distributed_init=lambda c, n, r: inits.append((c, n, r)),
+        )
+
+    monkeypatch.setattr(backends, "run_barrier_task", patched_run)
+    backend = backends.SparkBarrierBackend(
+        spark_session=FakeSparkSession(monkeypatch)
+    )
+    result = backend.run(3, lambda a, b: a + b, {"a": 2, "b": 40},
+                         verbosity="none")
+    assert result == 42
+    assert sorted(r for _, _, r in inits) == [0, 1, 2]
+
+
+def test_ship_output_unreachable_relay_is_harmless():
+    # a port with no listener: the context manager must degrade to no-op
+    with socket.socket() as s:
+        s.bind(("", 0))
+        dead = f"localhost:{s.getsockname()[1]}"
+    with _ShipOutput(dead, 0):
+        print("still fine")
