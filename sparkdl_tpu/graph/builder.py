@@ -55,35 +55,90 @@ class GraphFunction:
         return GraphFunction(gdef, payload["input_names"], payload["output_names"])
 
     # -- TPU-native lowering ----------------------------------------------
-    def to_jax(self) -> Callable[..., tuple]:
+    def to_jax(self, validate: bool = True,
+               prefer_native: bool = True) -> Callable[..., tuple]:
         """Lower to a jittable JAX function ``f(*arrays) -> tuple(arrays)``.
 
-        Inputs follow ``input_names`` order. Under ``jax.jit`` the TF graph
-        is compiled by TF's XLA bridge and inlined into the surrounding XLA
-        program (so it runs on TPU, not as a host callback). Graphs with ops
-        XLA cannot compile fail at first trace with the XLA error.
-        """
-        tf = require_tf()
-        from jax.experimental import jax2tf
+        Inputs follow ``input_names`` order. Two lowering paths:
 
+        1. **Native translation** (graph/tf2jax.py) — the frozen graph is
+           rebuilt as JAX ops, so it jits/fuses/shards on TPU with no TF
+           in the execution path. Used whenever every op is covered.
+        2. **call_tf fallback** — TF's XLA bridge inlines the graph into
+           the surrounding program. Requires a TF build with kernels for
+           the target platform: works on CPU hosts, but CPU-only TF
+           wheels cannot emit TPU programs (no XLA_TPU_JIT kernels), so
+           on TPU this path fails at first trace — which is why the
+           native translator is tried first.
+
+        The supported-op surface (graph/op_surface.py) is enforced here:
+        graphs holding host-side/stateful ops that can never compile raise
+        :class:`~sparkdl_tpu.graph.op_surface.UnsupportedGraphOpsError`
+        with per-node guidance; ``validate=False`` skips the prescreen, in
+        which case ops XLA cannot compile fail at first trace with the XLA
+        error.
+        """
+        if validate:
+            from sparkdl_tpu.graph.op_surface import validate_graph_def
+
+            validate_graph_def(self.graph_def)
         gdef = self.graph_def
         in_names = list(self.input_names)
         out_names = list(self.output_names)
-        specs = placeholder_specs(gdef, in_names)
 
-        def tf_fn(*tensors):
-            mapping = dict(zip(in_names, tensors))
-            outs = tf.graph_util.import_graph_def(
-                gdef, input_map=mapping, return_elements=out_names, name=""
-            )
-            return tuple(outs)
+        def make_call_tf():
+            tf = require_tf()
+            from jax.experimental import jax2tf
 
-        wrapped = tf.compat.v1.wrap_function(tf_fn, signature=specs)
-        lowered = jax2tf.call_tf(wrapped, has_side_effects=False)
+            specs = placeholder_specs(gdef, in_names)
+
+            def tf_fn(*tensors):
+                mapping = dict(zip(in_names, tensors))
+                outs = tf.graph_util.import_graph_def(
+                    gdef, input_map=mapping, return_elements=out_names,
+                    name="",
+                )
+                return tuple(outs)
+
+            wrapped = tf.compat.v1.wrap_function(tf_fn, signature=specs)
+            lowered = jax2tf.call_tf(wrapped, has_side_effects=False)
+
+            def fn(*arrays):
+                out = lowered(*arrays)
+                return out if isinstance(out, (tuple, list)) else (out,)
+
+            return fn
+
+        if not prefer_native:
+            return make_call_tf()
+
+        from sparkdl_tpu.graph.tf2jax import (
+            GraphTranslationError,
+            translate_graph_def,
+            untranslatable_ops,
+        )
+
+        if untranslatable_ops(gdef):
+            return make_call_tf()
+
+        # Op names are all covered, but an ATTR combination may still be
+        # outside the translation surface (NCHW convs, ellipsis-mask
+        # slices, ...), which only surfaces when the translator walks the
+        # graph with real inputs. Fall back to call_tf at that point, once,
+        # so such graphs keep working wherever TF can compile them.
+        native_fn = translate_graph_def(gdef, in_names, out_names)
+        chosen: list = []
 
         def fn(*arrays):
-            out = lowered(*arrays)
-            return out if isinstance(out, (tuple, list)) else (out,)
+            if chosen:
+                return chosen[0](*arrays)
+            try:
+                out = native_fn(*arrays)
+                chosen.append(native_fn)
+                return out
+            except GraphTranslationError:
+                chosen.append(make_call_tf())
+                return chosen[0](*arrays)
 
         return fn
 

@@ -1,0 +1,182 @@
+"""Supported-op surface for TF graph ingestion (SURVEY.md §7 hard part 1).
+
+The reference executed arbitrary TF graphs in a JVM-side TF session
+([U: tensorframes], SURVEY.md 2.15), so "supported" meant "any TF op with a
+CPU/GPU kernel". Here ingestion lowers the frozen graph through TF's XLA
+bridge into the surrounding JAX program (`GraphFunction.to_jax`), so the
+real support boundary is **what XLA can compile**:
+
+* SUPPORTED — dense math (MatMul/Conv/pooling/elementwise/reductions),
+  shape manipulation with static shapes, casts, softmax/activations,
+  functional control flow, XLA-compatible RNG. These lower and fuse.
+* REJECTED UP FRONT (this module) — op categories that can never compile
+  into a device program: host I/O and filesystem access, python callbacks,
+  string processing, hash/lookup tables, queues/readers/datasets, summary
+  writers, checkpoint save/restore, TF1 loop primitives, and un-frozen
+  variables (freeze first; `strip_and_freeze_upto` does this).
+* EVERYTHING ELSE — validated by XLA itself at first trace: ops outside
+  the denylist that XLA still cannot compile fail there with the XLA
+  error. The prescreen exists so the common hopeless cases fail at
+  ingestion time with actionable guidance instead of deep inside a jit
+  trace.
+
+`validate_graph_def` is called by `GraphFunction.to_jax()` (pass
+``validate=False`` to skip the prescreen and let XLA be the only judge).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+#: exact op names that cannot lower to a TPU program
+_REJECT_EXACT = {
+    # python callbacks
+    "PyFunc", "PyFuncStateless", "EagerPyFunc",
+    # host filesystem / IO
+    "ReadFile", "WriteFile", "MatchingFiles", "Print", "PrintV2", "Assert",
+    # image codecs (host-side work; use sparkdl_tpu.image.imageIO /
+    # native decode, then feed the decoded tensor)
+    "DecodeJpeg", "DecodePng", "DecodeGif", "DecodeBmp", "DecodeImage",
+    "EncodeJpeg", "EncodePng", "DecodeRaw", "DecodeCompressed",
+    # checkpoint plumbing
+    "Save", "SaveV2", "SaveSlices", "Restore", "RestoreV2", "RestoreSlice",
+    "MergeV2Checkpoints", "ShardedFilename", "ShardedFilespec",
+    # TF1 while-loop primitives (functional While/If lower fine; raw v1
+    # loop graphs don't survive the XLA bridge)
+    "Enter", "Exit", "NextIteration", "LoopCond", "RefEnter", "RefExit",
+    # misc host-state
+    "Mutex", "MutexLock", "MutexV2", "Barrier", "GetSessionHandle",
+    "GetSessionTensor", "DeleteSessionTensor", "Placeholder.deprecated",
+}
+
+#: op-name prefixes for whole rejected families
+_REJECT_PREFIXES = (
+    "String",        # string processing has no device representation
+    "Regex", "StaticRegex",
+    "AsString", "DecodeBase64", "EncodeBase64", "Substr", "UnicodeDecode",
+    "ParseExample", "ParseSequenceExample", "ParseSingleExample",
+    "DecodeCSV", "DecodeJSONExample", "SerializeTensor", "ParseTensor",
+    "LookupTable", "HashTable", "MutableHashTable", "MutableDenseHashTable",
+    "InitializeTable", "AnonymousHashTable",
+    "Queue", "FIFOQueue", "PaddingFIFOQueue", "RandomShuffleQueue",
+    "PriorityQueue", "Reader", "WholeFileReader", "TextLineReader",
+    "FixedLengthRecordReader", "TFRecordReader", "IdentityReader",
+    "Iterator", "OneShotIterator", "MultiDeviceIterator", "MakeIterator",
+    "AnonymousIterator", "DeserializeIterator", "SerializeIterator",
+    "BoostedTrees", "TensorForest",
+    "Audio", "Summary", "ScalarSummary", "HistogramSummary", "ImageSummary",
+    "MergeSummary", "WriteSummary", "CreateSummary",
+)
+
+#: variables must be frozen to constants before ingestion
+_VARIABLE_OPS = {
+    "Variable", "VariableV2", "VarHandleOp", "ReadVariableOp",
+    "AssignVariableOp", "AssignAddVariableOp", "AssignSubVariableOp",
+    "ResourceGather", "ResourceScatterAdd", "TemporaryVariable",
+}
+
+#: ops that match a rejected prefix but are, in fact, device-compilable
+_ALLOW_EXACT = {
+    "IteratorGetNextSync",  # never seen post-freeze, but harmless
+    "SummaryWriter",        # resource handle: unreachable post-freeze
+}
+
+
+class UnsupportedGraphOpsError(ValueError):
+    """Raised at ingestion when a frozen graph contains ops that can never
+    compile into the TPU program. Carries ``violations`` as a list of
+    (node_name, op_name, reason)."""
+
+    def __init__(self, violations: list[tuple[str, str, str]]):
+        self.violations = violations
+        shown = violations[:10]
+        lines = "\n".join(
+            f"  - node {name!r}: op {op!r} ({reason})"
+            for name, op, reason in shown
+        )
+        more = (
+            f"\n  ... and {len(violations) - len(shown)} more"
+            if len(violations) > len(shown) else ""
+        )
+        super().__init__(
+            f"graph contains {len(violations)} op(s) outside the "
+            f"TPU-compilable surface:\n{lines}{more}\n"
+            "Remedies: do host-side work (file IO, string parsing, image "
+            "decode) outside the graph and feed tensors — imageIO/"
+            "native decode covers the image case; freeze variables with "
+            "strip_and_freeze_upto; or pass validate=False to skip this "
+            "prescreen and let XLA report at first trace."
+        )
+
+
+def _classify(op: str) -> str | None:
+    """Reason string when ``op`` is outside the surface, else None."""
+    if op in _ALLOW_EXACT:
+        return None
+    if op in _VARIABLE_OPS:
+        return "un-frozen variable; freeze to constants first"
+    if op in _REJECT_EXACT:
+        return "host-side / stateful: cannot lower to a device program"
+    for prefix in _REJECT_PREFIXES:
+        if op.startswith(prefix):
+            return (
+                f"'{prefix}*' family is host-side: cannot lower to a "
+                "device program"
+            )
+    return None
+
+
+def _referenced_functions(nodes) -> set[str]:
+    """Function names referenced from ``nodes``' attrs (call ops like
+    PartitionedCall carry them in func/list-of-func attr values)."""
+    names = set()
+    for n in nodes:
+        for attr in n.attr.values():
+            if attr.func.name:
+                names.add(attr.func.name)
+            for f in attr.list.func:
+                if f.name:
+                    names.add(f.name)
+    return names
+
+
+def scan_graph_def(graph_def: Any) -> list[tuple[str, str, str]]:
+    """All (node_name, op, reason) violations in ``graph_def`` and in the
+    function-library bodies REACHABLE from it (defun bodies can hide host
+    ops). Unreachable library functions are ignored: TF2 SavedModels keep
+    dead ``__inference__traced_save/restore`` machinery in the library,
+    and dead save/restore ops can't hurt a program that never calls them.
+    """
+    violations = []
+
+    def scan_nodes(nodes, where=""):
+        for n in nodes:
+            reason = _classify(n.op)
+            if reason is not None:
+                violations.append((where + n.name, n.op, reason))
+
+    scan_nodes(graph_def.node)
+
+    by_name = {fn.signature.name: fn for fn in graph_def.library.function}
+    pending = _referenced_functions(graph_def.node)
+    seen: set[str] = set()
+    while pending:
+        name = pending.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        fn = by_name.get(name)
+        if fn is None:
+            continue
+        scan_nodes(fn.node_def, where=f"{name}/")
+        pending |= _referenced_functions(fn.node_def) - seen
+    return violations
+
+
+def validate_graph_def(graph_def: Any) -> None:
+    """Raise :class:`UnsupportedGraphOpsError` if the graph contains ops
+    that can never compile; silently pass otherwise (XLA remains the final
+    authority at trace time)."""
+    violations = scan_graph_def(graph_def)
+    if violations:
+        raise UnsupportedGraphOpsError(violations)

@@ -1,0 +1,667 @@
+"""Native TF-GraphDef -> JAX lowering (no TF at execution time).
+
+SURVEY.md §7 hard part 1 and §2 native-parity item 4: the reference ran
+frozen TF graphs in a C++ TF session ([U: tensorframes] / libtensorflow);
+the TPU build's equivalent is a *translator* that rebuilds the frozen graph
+as JAX ops, so the result jits, fuses, shards and runs on TPU like any
+other JAX code. The alternative lowering (`jax2tf.call_tf`) needs a TF
+build with XLA_TPU_JIT kernels — absent from CPU-only TF wheels — so on
+TPU hosts this translator IS the ingestion path; `GraphFunction.to_jax`
+uses it whenever every op is covered and falls back to call_tf otherwise.
+
+Scope: the frozen *inference* op surface (matmul/conv/BN-eval/pooling/
+elementwise/shape surgery) — what Keras/TF image and tabular models freeze
+to. Training ops, dynamic shapes and stateful ops are out of scope here
+and rejected earlier by graph/op_surface.py.
+
+Static-value discipline: shape-math chains (Shape -> StridedSlice -> Pack
+-> Reshape) must stay concrete under jit, so Const/Shape produce numpy
+values and dual-mode ops keep numpy inputs in numpy — they become trace
+constants, never tracers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from sparkdl_tpu.graph import utils as tfx
+
+
+class GraphTranslationError(ValueError):
+    """An op (or attr combination) outside the native translation surface."""
+
+
+# --------------------------------------------------------------------------
+# attr plumbing
+# --------------------------------------------------------------------------
+
+_DTYPES = {
+    1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 5: np.int16,
+    6: np.int8, 7: object, 9: np.int64, 10: np.bool_, 14: "bfloat16",
+    17: np.uint16, 19: "float16", 22: np.uint32, 23: np.uint64,
+}
+
+
+def _np_dtype(enum: int):
+    dt = _DTYPES.get(enum)
+    if dt is None or dt is object:
+        raise GraphTranslationError(f"unsupported tensor dtype enum {enum}")
+    if dt == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    if dt == "float16":
+        return np.float16
+    return dt
+
+
+def _attr(node, name, default=None):
+    if name not in node.attr:
+        return default
+    a = node.attr[name]
+    kind = a.WhichOneof("value")
+    if kind == "i":
+        return int(a.i)
+    if kind == "f":
+        return float(a.f)
+    if kind == "b":
+        return bool(a.b)
+    if kind == "s":
+        return a.s.decode()
+    if kind == "type":
+        return _np_dtype(a.type)
+    if kind == "list":
+        if a.list.i:
+            return [int(v) for v in a.list.i]
+        if a.list.f:
+            return [float(v) for v in a.list.f]
+        if a.list.s:
+            return [v.decode() for v in a.list.s]
+        return []
+    if kind == "shape":
+        return [d.size for d in a.shape.dim]
+    return default
+
+
+def _const_value(node) -> np.ndarray:
+    """Materialize a Const node's tensor (TF only needed at translate time)."""
+    from sparkdl_tpu.graph._tf import require_tf
+
+    tf = require_tf()
+    return np.asarray(tf.make_ndarray(node.attr["value"].tensor))
+
+
+def _is_static(x) -> bool:
+    return isinstance(x, (np.ndarray, np.generic, int, float, bool))
+
+
+def _static(x, node, what) -> np.ndarray:
+    if not _is_static(x):
+        raise GraphTranslationError(
+            f"node {node.name!r} ({node.op}): {what} must be statically "
+            "known (a Const or shape-derived value); a traced tensor "
+            "cannot drive shapes under jit"
+        )
+    return np.asarray(x)
+
+
+# --------------------------------------------------------------------------
+# translators: fn(xp, node, *inputs) -> value | tuple(values)
+# xp is numpy for all-static inputs of dual-mode ops, else jax.numpy —
+# keeping shape math concrete at trace time.
+# --------------------------------------------------------------------------
+
+_TRANSLATORS: dict[str, Callable] = {}
+_DUAL_MODE: set[str] = set()
+
+
+def _op(name, dual: bool = False):
+    def wrap(fn):
+        _TRANSLATORS[name] = fn
+        if dual:
+            _DUAL_MODE.add(name)
+        return fn
+
+    return wrap
+
+
+def _register_simple():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    # -- passthrough -----------------------------------------------------
+    for op in ("Identity", "StopGradient", "Snapshot", "PreventGradient",
+               "CheckNumerics", "EnsureShape", "PlaceholderWithDefault"):
+        _op(op, dual=True)(lambda xp, node, x, *rest: x)
+
+    # -- unary elementwise ----------------------------------------------
+    unary = {
+        "Relu": lambda x: jnp.maximum(x, 0),
+        "Relu6": lambda x: jnp.clip(x, 0, 6),
+        "Elu": jax.nn.elu,
+        "Selu": jax.nn.selu,
+        "Sigmoid": jax.nn.sigmoid,
+        "Tanh": jnp.tanh,
+        "Softplus": jax.nn.softplus,
+        "Softsign": jax.nn.soft_sign,
+        "Exp": jnp.exp, "Log": jnp.log, "Log1p": jnp.log1p,
+        "Sqrt": jnp.sqrt, "Rsqrt": lax.rsqrt, "Square": jnp.square,
+        "Neg": jnp.negative, "Abs": jnp.abs, "Sign": jnp.sign,
+        "Floor": jnp.floor, "Ceil": jnp.ceil, "Round": jnp.round,
+        "Erf": lax.erf, "Reciprocal": jnp.reciprocal,
+        "LogicalNot": jnp.logical_not,
+    }
+    for op, fn in unary.items():
+        _op(op)(lambda xp, node, x, _fn=fn: _fn(x))
+
+    _op("LeakyRelu")(
+        lambda xp, node, x: jax.nn.leaky_relu(x, _attr(node, "alpha", 0.2))
+    )
+    _op("Softmax")(lambda xp, node, x: jax.nn.softmax(x, axis=-1))
+    _op("LogSoftmax")(lambda xp, node, x: jax.nn.log_softmax(x, axis=-1))
+
+    # -- binary elementwise (numpy-compatible broadcasting) --------------
+    binary = {
+        "Add": lambda a, b, xp: xp.add(a, b),
+        "AddV2": lambda a, b, xp: xp.add(a, b),
+        "Sub": lambda a, b, xp: xp.subtract(a, b),
+        "Mul": lambda a, b, xp: xp.multiply(a, b),
+        "Div": lambda a, b, xp: xp.divide(a, b),
+        "RealDiv": lambda a, b, xp: xp.divide(a, b),
+        "FloorDiv": lambda a, b, xp: xp.floor_divide(a, b),
+        "FloorMod": lambda a, b, xp: xp.mod(a, b),
+        "Maximum": lambda a, b, xp: xp.maximum(a, b),
+        "Minimum": lambda a, b, xp: xp.minimum(a, b),
+        "Pow": lambda a, b, xp: xp.power(a, b),
+        "SquaredDifference": lambda a, b, xp: xp.square(
+            xp.subtract(a, b)),
+        "Greater": lambda a, b, xp: xp.greater(a, b),
+        "GreaterEqual": lambda a, b, xp: xp.greater_equal(a, b),
+        "Less": lambda a, b, xp: xp.less(a, b),
+        "LessEqual": lambda a, b, xp: xp.less_equal(a, b),
+        "Equal": lambda a, b, xp: xp.equal(a, b),
+        "NotEqual": lambda a, b, xp: xp.not_equal(a, b),
+        "LogicalAnd": lambda a, b, xp: xp.logical_and(a, b),
+        "LogicalOr": lambda a, b, xp: xp.logical_or(a, b),
+    }
+    for op, fn in binary.items():
+        _op(op, dual=True)(lambda xp, node, a, b, _fn=fn: _fn(a, b, xp))
+
+    _op("AddN", dual=True)(
+        lambda xp, node, *xs: functools.reduce(xp.add, xs)
+    )
+    _op("Select")(lambda xp, node, c, a, b: jnp.where(c, a, b))
+    _op("SelectV2")(lambda xp, node, c, a, b: jnp.where(c, a, b))
+    _op("ClipByValue")(
+        lambda xp, node, x, lo, hi: jnp.clip(x, lo, hi)
+    )
+
+    # -- casts -----------------------------------------------------------
+    @_op("Cast", dual=True)
+    def _cast(xp, node, x):
+        dt = _attr(node, "DstT")
+        return xp.asarray(x).astype(dt)
+
+    # -- matmul ----------------------------------------------------------
+    @_op("MatMul")
+    def _matmul(xp, node, a, b):
+        if _attr(node, "transpose_a", False):
+            a = jnp.swapaxes(a, -1, -2)
+        if _attr(node, "transpose_b", False):
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+    for op in ("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3"):
+        @_op(op)
+        def _bmm(xp, node, a, b):
+            if _attr(node, "adj_x", False):
+                a = jnp.swapaxes(a, -1, -2)
+            if _attr(node, "adj_y", False):
+                b = jnp.swapaxes(b, -1, -2)
+            return jnp.matmul(a, b)
+
+    @_op("Einsum")
+    def _einsum(xp, node, *xs):
+        return jnp.einsum(_attr(node, "equation"), *xs)
+
+    # -- conv / bn / bias ------------------------------------------------
+    def _conv_common(node, x, kernel, feature_group_count=1):
+        fmt = _attr(node, "data_format", "NHWC")
+        if fmt != "NHWC":
+            raise GraphTranslationError(
+                f"node {node.name!r}: data_format {fmt} unsupported "
+                "(NHWC only — the TPU-native layout)"
+            )
+        strides = _attr(node, "strides", [1, 1, 1, 1])
+        dil = _attr(node, "dilations", [1, 1, 1, 1])
+        padding = _attr(node, "padding", "VALID")
+        if padding == "EXPLICIT":
+            ep = _attr(node, "explicit_paddings", [])
+            pads = [(ep[2], ep[3]), (ep[4], ep[5])]
+        else:
+            pads = padding
+        return lax.conv_general_dilated(
+            x, kernel,
+            window_strides=strides[1:3],
+            padding=pads,
+            rhs_dilation=dil[1:3],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=feature_group_count,
+        )
+
+    @_op("Conv2D")
+    def _conv2d(xp, node, x, kernel):
+        return _conv_common(node, x, kernel)
+
+    @_op("DepthwiseConv2dNative")
+    def _dwconv(xp, node, x, kernel):
+        kh, kw, in_ch, mult = kernel.shape
+        kernel = kernel.reshape(kh, kw, 1, in_ch * mult)
+        return _conv_common(node, x, kernel, feature_group_count=in_ch)
+
+    for op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
+        @_op(op)
+        def _fbn(xp, node, x, scale, offset, mean, var):
+            if _attr(node, "is_training", True):
+                raise GraphTranslationError(
+                    f"node {node.name!r}: FusedBatchNorm in training mode "
+                    "— freeze the graph for inference first"
+                )
+            eps = _attr(node, "epsilon", 1e-3)
+            inv = lax.rsqrt(var + eps) * scale
+            return (x - mean) * inv + offset
+
+    @_op("BiasAdd")
+    def _bias(xp, node, x, b):
+        if _attr(node, "data_format", "NHWC") == "NCHW":
+            return x + b.reshape(1, -1, *([1] * (x.ndim - 2)))
+        return x + b
+
+    # -- pooling ---------------------------------------------------------
+    def _pool(node, x, reducer, init):
+        fmt = _attr(node, "data_format", "NHWC")
+        if fmt != "NHWC":
+            raise GraphTranslationError(
+                f"node {node.name!r}: data_format {fmt} unsupported")
+        ks = _attr(node, "ksize", [1, 1, 1, 1])
+        st = _attr(node, "strides", [1, 1, 1, 1])
+        pad = _attr(node, "padding", "VALID")
+        return lax.reduce_window(
+            x, init, reducer, tuple(ks), tuple(st), pad
+        )
+
+    @_op("MaxPool")
+    def _maxpool(xp, node, x):
+        return _pool(node, x, lax.max, -jnp.inf if
+                     jnp.issubdtype(x.dtype, jnp.floating) else
+                     jnp.iinfo(x.dtype).min)
+
+    @_op("AvgPool")
+    def _avgpool(xp, node, x):
+        # TF divides by the count of non-padded cells in each window
+        s = _pool(node, x, lax.add, 0.0 if
+                  jnp.issubdtype(x.dtype, jnp.floating) else 0)
+        ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
+        cnt = _pool(node, jnp.broadcast_to(ones, x.shape), lax.add, 0.0)
+        return s / cnt
+
+    # -- reductions ------------------------------------------------------
+    reductions = {
+        "Mean": jnp.mean, "Sum": jnp.sum, "Max": jnp.max, "Min": jnp.min,
+        "Prod": jnp.prod, "All": jnp.all, "Any": jnp.any,
+    }
+    for op, fn in reductions.items():
+        @_op(op)
+        def _reduce(xp, node, x, axes, _fn=fn):
+            axes = _static(axes, node, "reduction axes")
+            axis = tuple(int(a) for a in np.atleast_1d(axes))
+            return _fn(x, axis=axis or None,
+                       keepdims=_attr(node, "keep_dims", False))
+
+    @_op("ArgMax")
+    def _argmax(xp, node, x, axis):
+        axis = int(_static(axis, node, "axis"))
+        out = _attr(node, "output_type", np.int64)
+        return jnp.argmax(x, axis=axis).astype(out)
+
+    @_op("ArgMin")
+    def _argmin(xp, node, x, axis):
+        axis = int(_static(axis, node, "axis"))
+        out = _attr(node, "output_type", np.int64)
+        return jnp.argmin(x, axis=axis).astype(out)
+
+    # -- shape surgery ---------------------------------------------------
+    @_op("Shape", dual=True)
+    def _shape(xp, node, x):
+        if any(d is None for d in np.shape(x)):
+            raise GraphTranslationError(
+                f"node {node.name!r}: dynamic shape"
+            )
+        return np.asarray(np.shape(x),
+                          _attr(node, "out_type", np.int32))
+
+    @_op("Rank", dual=True)
+    def _rank(xp, node, x):
+        return np.asarray(np.ndim(x), np.int32)
+
+    @_op("Size", dual=True)
+    def _size(xp, node, x):
+        return np.asarray(np.size(x),
+                          _attr(node, "out_type", np.int32))
+
+    @_op("Reshape", dual=True)
+    def _reshape(xp, node, x, shape):
+        shape = _static(shape, node, "shape")
+        return xp.reshape(x, tuple(int(s) for s in shape))
+
+    @_op("Squeeze", dual=True)
+    def _squeeze(xp, node, x):
+        dims = _attr(node, "squeeze_dims") or _attr(node, "axis")
+        return xp.squeeze(x, axis=tuple(dims) if dims else None)
+
+    @_op("ExpandDims", dual=True)
+    def _expand(xp, node, x, axis):
+        return xp.expand_dims(x, int(_static(axis, node, "axis")))
+
+    @_op("ConcatV2", dual=True)
+    def _concat(xp, node, *xs):
+        axis = int(_static(xs[-1], node, "concat axis"))
+        return xp.concatenate(xs[:-1], axis=axis)
+
+    @_op("Concat", dual=True)
+    def _concat_v1(xp, node, axis, *xs):
+        return xp.concatenate(xs, axis=int(_static(axis, node, "axis")))
+
+    @_op("Pack", dual=True)
+    def _pack(xp, node, *xs):
+        return xp.stack(xs, axis=_attr(node, "axis", 0))
+
+    @_op("Unpack", dual=True)
+    def _unpack(xp, node, x):
+        axis = _attr(node, "axis", 0)
+        n = _attr(node, "num")
+        parts = xp.split(x, n, axis=axis)
+        return tuple(xp.squeeze(p, axis=axis) for p in parts)
+
+    @_op("Split")
+    def _split(xp, node, axis, x):
+        axis = int(_static(axis, node, "axis"))
+        return tuple(jnp.split(x, _attr(node, "num_split"), axis=axis))
+
+    @_op("SplitV")
+    def _splitv(xp, node, x, sizes, axis):
+        sizes = _static(sizes, node, "split sizes")
+        axis = int(_static(axis, node, "axis"))
+        idx = np.cumsum(sizes)[:-1]
+        return tuple(jnp.split(x, [int(i) for i in idx], axis=axis))
+
+    @_op("Transpose", dual=True)
+    def _transpose(xp, node, x, perm):
+        perm = _static(perm, node, "perm")
+        return xp.transpose(x, tuple(int(p) for p in perm))
+
+    for op in ("Pad", "PadV2"):
+        @_op(op, dual=True)
+        def _pad(xp, node, x, pads, *rest):
+            pads = _static(pads, node, "paddings")
+            value = rest[0] if rest else 0
+            return xp.pad(x, [(int(a), int(b)) for a, b in pads],
+                          constant_values=value)
+
+    @_op("Slice", dual=True)
+    def _slice(xp, node, x, begin, size):
+        begin = _static(begin, node, "begin")
+        size = _static(size, node, "size")
+        idx = tuple(
+            slice(int(b), None if int(s) == -1 else int(b) + int(s))
+            for b, s in zip(begin, size)
+        )
+        return xp.asarray(x)[idx]
+
+    @_op("StridedSlice", dual=True)
+    def _strided(xp, node, x, begin, end, strides):
+        begin = _static(begin, node, "begin")
+        end = _static(end, node, "end")
+        strides = _static(strides, node, "strides")
+        bm = _attr(node, "begin_mask", 0)
+        em = _attr(node, "end_mask", 0)
+        ell = _attr(node, "ellipsis_mask", 0)
+        na = _attr(node, "new_axis_mask", 0)
+        sa = _attr(node, "shrink_axis_mask", 0)
+        if ell or na:
+            raise GraphTranslationError(
+                f"node {node.name!r}: StridedSlice ellipsis/new-axis "
+                "masks unsupported"
+            )
+        idx = []
+        for i in range(len(begin)):
+            if sa & (1 << i):
+                idx.append(int(begin[i]))
+                continue
+            b = None if bm & (1 << i) else int(begin[i])
+            e = None if em & (1 << i) else int(end[i])
+            idx.append(slice(b, e, int(strides[i])))
+        return xp.asarray(x)[tuple(idx)]
+
+    @_op("GatherV2", dual=True)
+    def _gather(xp, node, params, indices, axis):
+        if _attr(node, "batch_dims", 0):
+            raise GraphTranslationError(
+                f"node {node.name!r}: GatherV2 with batch_dims != 0 "
+                "unsupported"
+            )
+        axis = int(_static(axis, node, "axis"))
+        return xp.take(params, indices, axis=axis)
+
+    @_op("Tile", dual=True)
+    def _tile(xp, node, x, multiples):
+        multiples = _static(multiples, node, "multiples")
+        return xp.tile(x, tuple(int(m) for m in multiples))
+
+    @_op("Fill", dual=True)
+    def _fill(xp, node, dims, value):
+        dims = _static(dims, node, "dims")
+        return xp.full(tuple(int(d) for d in dims), value)
+
+    @_op("Range", dual=True)
+    def _range(xp, node, start, limit, delta):
+        # dtypes follow the operands (float ranges stay float, like TF)
+        return np.arange(
+            _static(start, node, "start")[()],
+            _static(limit, node, "limit")[()],
+            _static(delta, node, "delta")[()],
+        )
+
+    @_op("ZerosLike", dual=True)
+    def _zeros_like(xp, node, x):
+        return xp.zeros_like(x)
+
+    @_op("OnesLike", dual=True)
+    def _ones_like(xp, node, x):
+        return xp.ones_like(x)
+
+    @_op("BroadcastTo", dual=True)
+    def _broadcast_to(xp, node, x, shape):
+        shape = _static(shape, node, "shape")
+        return xp.broadcast_to(x, tuple(int(s) for s in shape))
+
+    # -- image resize (the reference's in-graph decode/resize, 2.10) -----
+    @_op("ResizeBilinear")
+    def _resize_bilinear(xp, node, x, size):
+        if _attr(node, "half_pixel_centers", False):
+            return _resize(node, x, size, "bilinear")
+        # TF1 legacy convention (the default in frozen TF1 graphs, the
+        # reference's ingestion case): src = dst * (in/out), no half-pixel
+        # shift — jax.image.resize has no mode for it, so interpolate
+        # explicitly.
+        return _legacy_bilinear(node, x, size)
+
+    @_op("ResizeNearestNeighbor")
+    def _resize_nn(xp, node, x, size):
+        if not _attr(node, "half_pixel_centers", False):
+            raise GraphTranslationError(
+                f"node {node.name!r}: legacy (half_pixel_centers=False) "
+                "nearest resize unsupported"
+            )
+        return _resize(node, x, size, "nearest")
+
+    def _resize(node, x, size, method):
+        import jax.image
+
+        if _attr(node, "align_corners", False):
+            raise GraphTranslationError(
+                f"node {node.name!r}: align_corners resize unsupported"
+            )
+        size = _static(size, node, "size")
+        h, w = int(size[0]), int(size[1])
+        out = jax.image.resize(
+            x.astype(jnp.float32),
+            (x.shape[0], h, w, x.shape[3]), method=method,
+            antialias=False,
+        )
+        return out.astype(x.dtype)
+
+    def _legacy_bilinear(node, x, size):
+        if _attr(node, "align_corners", False):
+            raise GraphTranslationError(
+                f"node {node.name!r}: align_corners resize unsupported"
+            )
+        size = _static(size, node, "size")
+        h, w = int(size[0]), int(size[1])
+        in_h, in_w = x.shape[1], x.shape[2]
+        xf = x.astype(jnp.float32)
+
+        def axis_weights(out_n, in_n):
+            src = np.arange(out_n, dtype=np.float64) * (in_n / out_n)
+            lo = np.floor(src).astype(np.int64)
+            lo = np.clip(lo, 0, in_n - 1)
+            hi = np.minimum(lo + 1, in_n - 1)
+            frac = (src - lo).astype(np.float32)
+            return lo, hi, frac
+
+        y0, y1, wy = axis_weights(h, in_h)
+        x0, x1, wx = axis_weights(w, in_w)
+        top = jnp.take(xf, y0, axis=1)
+        bot = jnp.take(xf, y1, axis=1)
+        rows = top + (bot - top) * wy[None, :, None, None]
+        left = jnp.take(rows, x0, axis=2)
+        right = jnp.take(rows, x1, axis=2)
+        out = left + (right - left) * wx[None, None, :, None]
+        return out.astype(x.dtype)
+
+
+_register_simple()
+
+
+# --------------------------------------------------------------------------
+# graph walking
+# --------------------------------------------------------------------------
+
+
+def untranslatable_ops(graph_def) -> "list[str]":
+    """Ops in ``graph_def`` that the native translator does NOT cover
+    (empty list == fully translatable). Const/Placeholder/NoOp are
+    structural and always fine."""
+    structural = {"Const", "Placeholder", "NoOp"}
+    return sorted({
+        n.op for n in graph_def.node
+        if n.op not in structural and n.op not in _TRANSLATORS
+    })
+
+
+def translate_graph_def(
+    graph_def,
+    input_names: Sequence[str],
+    output_names: Sequence[str],
+) -> Callable[..., tuple]:
+    """Build ``f(*arrays) -> tuple(arrays)`` executing the frozen graph as
+    native JAX ops (inputs/outputs in the given tensor-name order)."""
+    import jax.numpy as jnp
+
+    nodes = {n.name: n for n in graph_def.node}
+    missing = untranslatable_ops(graph_def)
+    if missing:
+        raise GraphTranslationError(
+            f"graph has ops outside the native translation surface: "
+            f"{', '.join(missing)}"
+        )
+
+    in_ops = [tfx.op_name(n) for n in input_names]
+    out_refs = [(tfx.op_name(n), tfx.output_index(n)) for n in output_names]
+
+    # topo order over the subgraph feeding the outputs
+    order: list[str] = []
+    state: dict[str, int] = {}  # 0=visiting, 1=done
+
+    def visit(name: str):
+        stack = [(name, False)]
+        while stack:
+            cur, expanded = stack.pop()
+            if state.get(cur) == 1:
+                continue
+            if expanded:
+                state[cur] = 1
+                order.append(cur)
+                continue
+            state[cur] = 0
+            stack.append((cur, True))
+            node = nodes.get(cur)
+            if node is None:
+                raise GraphTranslationError(f"missing node {cur!r}")
+            for inp in node.input:
+                if inp.startswith("^"):
+                    continue  # control edges: frozen graphs carry no state
+                dep = tfx.op_name(inp)
+                if state.get(dep) != 1:
+                    stack.append((dep, False))
+
+    for name, _ in out_refs:
+        visit(name)
+
+    consts: dict[str, np.ndarray] = {}
+
+    def fn(*arrays) -> tuple:
+        if len(arrays) != len(in_ops):
+            raise TypeError(
+                f"expected {len(in_ops)} inputs, got {len(arrays)}"
+            )
+        env: dict[str, Any] = {}
+        for op_name_, arr in zip(in_ops, arrays):
+            env[op_name_] = (arr,)
+        for name in order:
+            if name in env:
+                continue  # fed placeholder
+            node = nodes[name]
+            if node.op == "Const":
+                if name not in consts:
+                    consts[name] = _const_value(node)
+                env[name] = (consts[name],)
+                continue
+            if node.op == "Placeholder":
+                raise GraphTranslationError(
+                    f"placeholder {name!r} is not in input_names"
+                )
+            if node.op == "NoOp":
+                env[name] = ()
+                continue
+            ins = []
+            for inp in node.input:
+                if inp.startswith("^"):
+                    continue
+                dep, idx = tfx.op_name(inp), tfx.output_index(inp)
+                ins.append(env[dep][idx])
+            translator = _TRANSLATORS[node.op]
+            if node.op in _DUAL_MODE and all(_is_static(i) for i in ins):
+                out = translator(np, node, *ins)
+            else:
+                out = translator(jnp, node, *ins)
+            env[name] = out if isinstance(out, tuple) else (out,)
+        return tuple(
+            jnp.asarray(env[name][idx]) for name, idx in out_refs
+        )
+
+    return fn

@@ -39,6 +39,17 @@ def tensor_name(name, graph=None) -> str:
     return out
 
 
+def output_index(name) -> int:
+    """Output slot of a tensor reference: ``"op:2" -> 2``, bare op -> 0."""
+    raw = _as_name(name)
+    parts = raw.split(":")
+    if len(parts) == 2 and parts[1].isdigit():
+        return int(parts[1])
+    if len(parts) == 1:
+        return 0
+    raise ValueError(f"invalid tensor name {raw!r}")
+
+
 def get_tensor(name, graph):
     return graph.get_tensor_by_name(tensor_name(name))
 
