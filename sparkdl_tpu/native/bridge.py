@@ -30,6 +30,18 @@ def native_available() -> bool:
     return _lib.available()
 
 
+#: process-wide feeder telemetry: how many streams rode the native ring vs
+#: the python fallback, and batches/bytes through the ring. Read by tests
+#: (the "does the hot path actually traverse the ring" proof) and by
+#: bench_hostfed's report.
+FEED_STATS = {
+    "ring_streams": 0,
+    "fallback_streams": 0,
+    "ring_batches": 0,
+    "ring_bytes": 0,
+}
+
+
 # ---------------------------------------------------------------------------
 # Staging ring
 # ---------------------------------------------------------------------------
@@ -219,6 +231,7 @@ class DeviceFeeder:
         first = np.ascontiguousarray(first)
         slot_bytes = self._max_bytes or first.nbytes
         if not native_available():
+            FEED_STATS["fallback_streams"] += 1
             # Pure-Python path: same overlap via the prefetch queue.
             from sparkdl_tpu.runtime.prefetch import prefetch_to_device
 
@@ -234,6 +247,7 @@ class DeviceFeeder:
             return
 
         ring = StagingRing(slot_bytes, self._n_slots)
+        FEED_STATS["ring_streams"] += 1
         meta: dict[int, tuple] = {}  # slot idx -> (shape, dtype)
         out_q: queue.Queue = queue.Queue(maxsize=self._n_slots)
         stop = threading.Event()
@@ -258,6 +272,8 @@ class DeviceFeeder:
                     view[: batch.nbytes] = batch.view(np.uint8).reshape(-1)
                     meta[idx] = (batch.shape, batch.dtype)
                     ring.commit_write(idx, batch.shape[0], batch.nbytes)
+                    FEED_STATS["ring_batches"] += 1
+                    FEED_STATS["ring_bytes"] += batch.nbytes
             except BaseException as e:
                 errors.append(e)
             finally:

@@ -26,11 +26,26 @@ class BatchedRunner:
 
     apply_fn must be shape-polymorphic only across the bucket set (it is
     jitted; one compile per bucket). Outputs follow the batch leading dim.
+
+    Host->device staging: uniform-row single-tensor feeds (the image
+    featurization paths) ride the native C++ staging ring
+    (:class:`~sparkdl_tpu.native.bridge.DeviceFeeder`): packer thread ->
+    stable slot -> transfer thread -> device, double-buffered so the chip
+    computes batch i while batch i+1 is on the wire and i+2 is packing —
+    the TensorFrames-block-feed equivalent (SURVEY.md 2.15) on the actual
+    hot path. Multi-tensor feeds (e.g. text's input_ids+attention_mask),
+    ragged feeds, and hosts without the .so use the pure-Python
+    prefetcher with the same overlap semantics.
+
+    ``ragged_rows=True`` declares that row shapes vary across batches
+    (e.g. un-resized images into a dynamic-spatial graph): ring slots are
+    fixed-size, so such feeds must keep to the Python path.
     """
 
     apply_fn: Callable[[dict[str, Any]], Any]
     batch_size: int = 64
     prefetch: int = 2
+    ragged_rows: bool = False
 
     def __post_init__(self):
         self._jitted = jax.jit(self.apply_fn)
@@ -46,14 +61,12 @@ class BatchedRunner:
         # keep (n_valid) alongside the device computation
         metas: list[int] = []
 
-        def device_batches():
+        def host_batches():
             for b in batches:
                 metas.append(b.n_valid)
                 yield b.arrays
 
-        results = prefetch_to_device(
-            device_batches(), size=self.prefetch, transfer=self._transfer
-        )
+        results = self._device_feed(host_batches())
         for i, out in enumerate(map(self._jitted, results)):
             n = metas[i]
             if isinstance(out, (tuple, list)):
@@ -62,6 +75,42 @@ class BatchedRunner:
                     yield tuple(a[j] for a in arrays)
             else:
                 yield from np.asarray(out)[:n]
+
+    def _device_feed(
+        self, host_batches: Iterator[dict[str, np.ndarray]]
+    ) -> Iterator[dict[str, Any]]:
+        """Stage host batch dicts onto the device with transfer/compute
+        overlap; picks the native ring when it applies."""
+        from sparkdl_tpu.native.bridge import DeviceFeeder, native_available
+
+        it = iter(host_batches)
+        try:
+            first = next(it)
+        except StopIteration:
+            return
+        keys = list(first)
+
+        def chained():
+            yield first
+            yield from it
+
+        if native_available() and len(keys) == 1 and not self.ragged_rows:
+            (key,) = keys
+            v0 = first[key]
+            # slots sized for the LARGEST bucket; the first batch may be a
+            # smaller tail bucket
+            row_bytes = v0.nbytes // max(v0.shape[0], 1)
+            feeder = DeviceFeeder(
+                (b[key] for b in chained()),
+                n_slots=self.prefetch + 1,
+                max_batch_bytes=row_bytes * self.batch_size,
+            )
+            for arr in feeder:
+                yield {key: arr}
+            return
+        yield from prefetch_to_device(
+            chained(), size=self.prefetch, transfer=self._transfer
+        )
 
     def _transfer(self, arrays: dict[str, np.ndarray]):
         return jax.device_put(arrays)
@@ -72,7 +121,8 @@ _GRAPH_RUNNERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def cached_graph_runner(graph, key, make_apply_fn: Callable[[], Callable],
-                        batch_size: int) -> BatchedRunner:
+                        batch_size: int,
+                        ragged_rows: bool = False) -> BatchedRunner:
     """Process-wide BatchedRunner cache keyed by (graph identity, key).
 
     One jax.jit per (ingested graph, shape/batch config) no matter how many
@@ -80,7 +130,9 @@ def cached_graph_runner(graph, key, make_apply_fn: Callable[[], Callable],
     """
     per_graph = _GRAPH_RUNNERS.setdefault(graph, {})
     if key not in per_graph:
-        per_graph[key] = BatchedRunner(make_apply_fn(), batch_size=batch_size)
+        per_graph[key] = BatchedRunner(
+            make_apply_fn(), batch_size=batch_size, ragged_rows=ragged_rows
+        )
     return per_graph[key]
 
 

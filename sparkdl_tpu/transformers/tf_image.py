@@ -116,7 +116,10 @@ class TFImageTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
             rows = list(rows)
             if not rows:
                 return iter(())
-            runner = self._runner(gin, batched_input, batch_size)
+            runner = self._runner(
+                gin, batched_input, batch_size,
+                ragged_rows=static_size is None,
+            )
 
             def extract(row):
                 arr = _image_to_rgb_array(row[input_col])
@@ -136,7 +139,8 @@ class TFImageTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
         return transform_partitions(dataset, partition_fn, schema)
 
     @staticmethod
-    def _runner(gin: TFInputGraph, batched_input: bool, batch_size: int):
+    def _runner(gin: TFInputGraph, batched_input: bool, batch_size: int,
+                ragged_rows: bool = False):
         def make_apply_fn():
             fn = gin.to_jax()
             if batched_input:
@@ -151,7 +155,8 @@ class TFImageTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
             return apply_fn
 
         return cached_graph_runner(
-            gin, (batched_input, batch_size), make_apply_fn, batch_size
+            gin, (batched_input, batch_size, ragged_rows), make_apply_fn,
+            batch_size, ragged_rows=ragged_rows,
         )
 
     @staticmethod
