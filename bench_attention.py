@@ -1,0 +1,122 @@
+"""Pallas flash-attention hardware proof (VERDICT round-1 next-step #3).
+
+Compiles the fused fwd+bwd kernels on the real chip (interpret=False path
+— Mosaic compilation, VMEM budgets and all), asserts bf16-tolerance
+correctness against the naive masked-softmax reference ON HARDWARE, and
+reports the fwd+bwd speedup at L in {1024, 4096}. Prints ONE JSON line.
+
+Run: python bench_attention.py    (driver-style; TPU under the driver)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def naive_attention(q, k, v, causal):
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / (d ** 0.5)
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        mask = np.tril(np.ones((lq, lk), bool))
+        s = jnp.where(jnp.asarray(mask), s, -1e30)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+
+def main() -> None:
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    # sitecustomize pre-selects the TPU platform; honor an explicit
+    # JAX_PLATFORMS (same contract as bench.py) so CPU smokes stay on CPU.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from sparkdl_tpu.ops.flash_attention import flash_attention
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu"
+    interpret = not on_tpu  # compiled Mosaic on hardware — the whole point
+    b, h, d = 2, 8, 64
+    lengths = (1024, 4096) if on_tpu else (256,)
+    steps = 20 if on_tpu else 2
+
+    results = {}
+    max_err = 0.0
+    for L in lengths:
+        rng = np.random.default_rng(L)
+        shape = (b, L, h, d)
+        q = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+
+        def flash_loss(q, k, v):
+            o = flash_attention(q, k, v, causal=True, interpret=interpret)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        def naive_loss(q, k, v):
+            return jnp.sum(naive_attention(q, k, v, causal=True) ** 2)
+
+        flash_g = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))
+        naive_g = jax.jit(jax.grad(naive_loss, argnums=(0, 1, 2)))
+
+        # -- correctness on hardware: fwd + all three grads ---------------
+        fo = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, interpret=interpret))(q, k, v)
+        no = naive_attention(q, k, v, causal=True)
+        fwd_err = float(jnp.max(jnp.abs(fo.astype(jnp.float32) - no)))
+        gf, gn = flash_g(q, k, v), naive_g(q, k, v)
+        bwd_err = max(
+            float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                  - b_.astype(jnp.float32))))
+            for a, b_ in zip(gf, gn)
+        )
+        # bf16 inputs, f32 accumulation: elementwise diffs stay O(bf16 eps)
+        # on the O(1)-normalized outputs; grads accumulate over L so allow
+        # a scaled tolerance.
+        assert fwd_err < 0.05, f"L={L} fwd diverged: {fwd_err}"
+        assert bwd_err < 0.5 + 1e-4 * L, f"L={L} bwd diverged: {bwd_err}"
+        max_err = max(max_err, fwd_err)
+
+        def timeit(fn):
+            fn(q, k, v)  # compile
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(steps):
+                out = fn(q, k, v)
+            leaf = out[0] if isinstance(out, tuple) else out
+            float(leaf.astype(jnp.float32).sum())  # forced scalar read
+            return (time.perf_counter() - t0) / steps
+
+        t_flash = timeit(flash_g)
+        t_naive = timeit(naive_g)
+        results[L] = {
+            "flash_ms": round(t_flash * 1e3, 2),
+            "naive_ms": round(t_naive * 1e3, 2),
+            "speedup": round(t_naive / t_flash, 2),
+        }
+
+    headline = max(lengths)
+    print(json.dumps({
+        "metric": f"flash-attention fwd+bwd speedup vs naive "
+                  f"(L={headline}, {platform}, compiled={not interpret})",
+        "value": results[headline]["speedup"],
+        "unit": "x",
+        "vs_baseline": results[headline]["speedup"],
+        "detail": results,
+        "max_fwd_abs_err": round(max_err, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
