@@ -30,26 +30,31 @@ def main() -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
 
-    from sparkdl_tpu.models.registry import build_flax_model, get_entry
+    from sparkdl_tpu.models.registry import build_flax_model
     from sparkdl_tpu.ops.preprocess import PREPROCESSORS
 
     platform = jax.default_backend()
     on_accel = platform not in ("cpu",)
     batch = int(os.environ.get("BENCH_BATCH", 128 if on_accel else 8))
-    steps = int(os.environ.get("BENCH_STEPS", 15 if on_accel else 3))
+    steps = int(os.environ.get("BENCH_STEPS", 30 if on_accel else 3))
     # Per-dispatch program-launch overhead on the relayed chip is ~2.5 ms —
     # measurable against a 14 ms program — so the benched unit scans K
     # batches per dispatch (every image still processed exactly once per
     # step; PERF.md "scan-K" has the measurements).
-    scan_k = int(os.environ.get("BENCH_SCAN_K", 8 if on_accel else 1))
+    scan_k = int(os.environ.get("BENCH_SCAN_K", 16 if on_accel else 1))
     size = 299 if on_accel else 128  # CPU smoke keeps compile/runtime sane
 
-    entry = get_entry("InceptionV3")
     dtype = jnp.bfloat16 if on_accel else jnp.float32
     module, variables = build_flax_model(
         "InceptionV3", weights=None, include_top=False, dtype=dtype
     )
-    preprocess = PREPROCESSORS[entry.preprocess]
+    # 'tf' preprocessing folded into the stem weights (exact — see
+    # ops/fold.py + tests/ops/test_fold.py): the program eats raw pixels,
+    # saving one full-image elementwise pass per batch.
+    from sparkdl_tpu.ops.fold import fold_tf_preprocess
+
+    variables = fold_tf_preprocess(variables)
+    preprocess = PREPROCESSORS["identity"]
 
     def featurize_one(x):
         feats, _ = module.apply(

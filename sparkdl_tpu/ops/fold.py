@@ -1,0 +1,60 @@
+"""Algebraic folds that remove whole passes from the inference program.
+
+``fold_tf_preprocess``: the 'tf' preprocessing mode (x/127.5 - 1, used by
+InceptionV3/Xception — SURVEY.md 2.1's preprocessing registry) is an
+affine map, and the stem is conv(VALID) -> BatchNorm, both linear in x. So
+the preprocessing can be folded exactly into the stem weights:
+
+    conv(x/127.5 - 1, W) = conv(x, W/127.5) - S,   S[o] = sum W[..., o]
+    BN eval subtracts the running mean, so mean' = mean + S absorbs S.
+
+(VALID padding matters: a constant input yields the same S at every output
+position only when no zero padding enters the window.) After folding, the
+jitted program consumes raw uint8-cast pixels directly — one full-image
+elementwise pass (read 34 MB + write 68 MB per 128-batch at 299px) gone.
+Measured on the v5e as part of the bench.py program (PERF.md).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def fold_tf_preprocess(variables: dict, conv: str = "conv000",
+                       bn: str = "bn000") -> dict:
+    """Return new ``variables`` with 'tf'-mode preprocessing folded into
+    the stem conv + BN. The model must then be fed RAW [0,255] pixels with
+    the identity preprocessor.
+
+    Asserted here: the stem conv is bias-free and the BN has a running
+    mean. NOT checkable from ``variables`` alone (the caller must
+    guarantee it): the stem conv uses VALID padding — with SAME padding
+    the "-1" response is position-dependent at the borders and this fold
+    is silently wrong. Both zoo 'tf'-mode stems (InceptionV3, Xception)
+    are VALID.
+    """
+    params = variables["params"]
+    stats = variables.get("batch_stats", {})
+    if conv not in params or "kernel" not in params[conv]:
+        raise ValueError(f"no stem conv {conv!r} in params")
+    if "bias" in params[conv]:
+        raise ValueError(
+            f"stem conv {conv!r} has a bias; fold expects the zoo's "
+            "bias-free conv+BN stem"
+        )
+    if bn not in stats or "mean" not in stats[bn]:
+        raise ValueError(f"no running mean for {bn!r} in batch_stats")
+
+    orig = params[conv]["kernel"]
+    kernel = orig / 127.5
+    # S[o]: the stem's response to the "-1" term rides the ORIGINAL
+    # kernel scale — conv(x/127.5 - 1, W) = conv(x, W/127.5) - sum(W)
+    shift = jnp.sum(orig, axis=(0, 1, 2))
+    new_params = dict(params)
+    new_params[conv] = dict(params[conv], kernel=kernel)
+    new_stats = dict(stats)
+    new_stats[bn] = dict(stats[bn], mean=stats[bn]["mean"] + shift)
+    out = dict(variables)
+    out["params"] = new_params
+    out["batch_stats"] = new_stats
+    return out

@@ -1,0 +1,52 @@
+"""fold_tf_preprocess fidelity: raw-pixel forward through folded weights
+must match the preprocessed forward through the original weights exactly
+(same program arithmetic, just rearranged constants)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.registry import build_flax_model
+from sparkdl_tpu.ops.fold import fold_tf_preprocess
+from sparkdl_tpu.ops.preprocess import preprocess_tf
+
+
+@pytest.mark.parametrize("name", ["InceptionV3", "Xception"])
+def test_folded_stem_matches_preprocessed_forward(name):
+    module, variables = build_flax_model(
+        name, weights=None, include_top=False
+    )
+    folded = fold_tf_preprocess(variables)
+
+    rng = np.random.default_rng(0)
+    size = 96 if name == "InceptionV3" else 96
+    x = jnp.asarray(
+        rng.integers(0, 256, (2, size, size, 3)).astype(np.float32)
+    )
+
+    ref, _ = jax.jit(
+        lambda v, x: module.apply(v, preprocess_tf(x), train=False)
+    )(variables, x)
+    got, _ = jax.jit(
+        lambda v, x: module.apply(v, x, train=False)
+    )(folded, x)
+    # Same math, different association: x*(W/127.5) rounds differently
+    # than (x/127.5-1)*W in f32; tolerance covers the reassociation
+    # drift only.
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=5e-4
+    )
+
+
+def test_fold_rejects_biased_or_missing_stem():
+    module, variables = build_flax_model(
+        "InceptionV3", weights=None, include_top=False
+    )
+    with pytest.raises(ValueError, match="no stem conv"):
+        fold_tf_preprocess(variables, conv="conv999")
+    with pytest.raises(ValueError, match="running mean"):
+        fold_tf_preprocess(variables, bn="bn999")
