@@ -34,6 +34,16 @@ class GraphTranslationError(ValueError):
     """An op (or attr combination) outside the native translation surface."""
 
 
+#: f32-contraction precision for the CURRENT translation execution
+#: ("highest" = 6-pass f32 on the MXU, matches a TF session bit-for-bit-ish;
+#: "default" = bf16 passes, ~6x faster, serving-grade). Set per-call by
+#: translate_graph_def; contextvar so nested/jitted traces see the right one.
+import contextvars
+
+_F32_PRECISION = contextvars.ContextVar("sparkdl_tf2jax_f32_precision",
+                                        default="highest")
+
+
 # --------------------------------------------------------------------------
 # attr plumbing
 # --------------------------------------------------------------------------
@@ -207,13 +217,28 @@ def _register_simple():
         return xp.asarray(x).astype(dt)
 
     # -- matmul ----------------------------------------------------------
+    # f32 contractions honor the per-translation f32_precision setting:
+    # "highest" (default) matches the TF session the graph is
+    # oracle-checked against — TPU's default bf16 passes would silently
+    # diverge; "default" trades that fidelity for ~6x faster serving.
+    # bf16/f16 operands are unaffected (already low precision by choice).
+    def _prec(*operands):
+        if _F32_PRECISION.get() != "highest":
+            return None
+        return (
+            jax.lax.Precision.HIGHEST
+            if any(np.result_type(getattr(o, "dtype", np.float32))
+                   == np.float32 for o in operands)
+            else None
+        )
+
     @_op("MatMul")
     def _matmul(xp, node, a, b):
         if _attr(node, "transpose_a", False):
             a = jnp.swapaxes(a, -1, -2)
         if _attr(node, "transpose_b", False):
             b = jnp.swapaxes(b, -1, -2)
-        return jnp.matmul(a, b)
+        return jnp.matmul(a, b, precision=_prec(a, b))
 
     for op in ("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3"):
         @_op(op)
@@ -222,11 +247,12 @@ def _register_simple():
                 a = jnp.swapaxes(a, -1, -2)
             if _attr(node, "adj_y", False):
                 b = jnp.swapaxes(b, -1, -2)
-            return jnp.matmul(a, b)
+            return jnp.matmul(a, b, precision=_prec(a, b))
 
     @_op("Einsum")
     def _einsum(xp, node, *xs):
-        return jnp.einsum(_attr(node, "equation"), *xs)
+        return jnp.einsum(_attr(node, "equation"), *xs,
+                          precision=_prec(*xs))
 
     # -- conv / bn / bias ------------------------------------------------
     def _conv_common(node, x, kernel, feature_group_count=1):
@@ -251,6 +277,7 @@ def _register_simple():
             rhs_dilation=dil[1:3],
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=feature_group_count,
+            precision=_prec(x, kernel),
         )
 
     @_op("Conv2D")
@@ -577,10 +604,23 @@ def translate_graph_def(
     graph_def,
     input_names: Sequence[str],
     output_names: Sequence[str],
+    f32_precision: str = "highest",
 ) -> Callable[..., tuple]:
     """Build ``f(*arrays) -> tuple(arrays)`` executing the frozen graph as
-    native JAX ops (inputs/outputs in the given tensor-name order)."""
+    native JAX ops (inputs/outputs in the given tensor-name order).
+
+    ``f32_precision``: "highest" (default) runs f32 contractions at full
+    f32 MXU precision to match the originating TF session; "default" uses
+    the TPU's native bf16 passes (~6x faster contractions) for serving
+    where bf16-grade features are acceptable.
+    """
     import jax.numpy as jnp
+
+    if f32_precision not in ("highest", "default"):
+        raise ValueError(
+            f"f32_precision must be 'highest' or 'default', "
+            f"got {f32_precision!r}"
+        )
 
     nodes = {n.name: n for n in graph_def.node}
     missing = untranslatable_ops(graph_def)
@@ -625,6 +665,13 @@ def translate_graph_def(
     consts: dict[str, np.ndarray] = {}
 
     def fn(*arrays) -> tuple:
+        token = _F32_PRECISION.set(f32_precision)
+        try:
+            return _run(*arrays)
+        finally:
+            _F32_PRECISION.reset(token)
+
+    def _run(*arrays) -> tuple:
         if len(arrays) != len(in_ops):
             raise TypeError(
                 f"expected {len(in_ops)} inputs, got {len(arrays)}"
