@@ -33,6 +33,7 @@ def main() -> None:
     from sparkdl_tpu.graph.builder import IsolatedSession
     from sparkdl_tpu.graph.input import TFInputGraph
 
+    rows = int(os.environ.get("BENCH_BATCH", 256))
     rng = np.random.default_rng(0)
     w1 = rng.standard_normal((16, 64)).astype(np.float32) * 0.3
     w2 = rng.standard_normal((64, 8)).astype(np.float32) * 0.3
@@ -42,7 +43,7 @@ def main() -> None:
         h = tf.nn.relu(tf.matmul(x, tf.constant(w1)))
         y = tf.nn.softmax(tf.matmul(h, tf.constant(w2)), name="y")
         gfn = sess.asGraphFunction([x], [y])
-        batch = rng.standard_normal((256, 16)).astype(np.float32)
+        batch = rng.standard_normal((rows, 16)).astype(np.float32)
         oracle = sess.run(y, feed_dict={x: batch})
 
     tig = TFInputGraph.fromGraphDef(gfn.graph_def, ["x:0"], ["y:0"])

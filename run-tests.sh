@@ -17,6 +17,17 @@ rec = json.loads(line)
 assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
 print("bench.py contract OK")
 '
+# Secondary benches keep the same one-JSON-line contract (values are
+# CPU-smoke only; the real numbers come from the chip — PERF.md).
+for b in bench_tf_ingest.py bench_hostfed.py; do
+  JAX_PLATFORMS=cpu BENCH_IMAGES=64 BENCH_BATCH=16 python "$b" | tail -1 | python -c '
+import json, sys
+rec = json.loads(sys.stdin.readline())
+assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
+print("contract OK:", rec["metric"][:60])
+'
+done
+
 # The driver's EXACT call form: import the module, call dryrun_multichip(8)
 # with however many devices this host exposes (1 here — JAX_PLATFORMS=cpu
 # without a forced device count), so the self-provisioning re-exec path is

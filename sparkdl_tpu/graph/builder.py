@@ -83,6 +83,11 @@ class GraphFunction:
         contractions) or "default" (TPU bf16 passes, ~6x faster) — native
         path only.
         """
+        if f32_precision not in ("highest", "default"):
+            raise ValueError(
+                f"f32_precision must be 'highest' or 'default', "
+                f"got {f32_precision!r}"
+            )
         if validate:
             from sparkdl_tpu.graph.op_surface import validate_graph_def
 

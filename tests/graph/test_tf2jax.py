@@ -263,6 +263,33 @@ def test_untranslatable_op_reported_and_falls_back_to_call_tf():
     np.testing.assert_allclose(np.asarray(got), oracle(x_np)[0], atol=1e-5)
 
 
+def test_f32_precision_knob():
+    """'highest' (default) and 'default' both execute and agree on CPU
+    (the divergence is TPU-only bf16 passes); invalid values raise on
+    every lowering path, including the call_tf fallback."""
+    x_np = rng.standard_normal((3, 6)).astype(np.float32)
+
+    def build():
+        x = v1.placeholder(tf.float32, [None, 6], name="x")
+        w = tf.constant(rng.standard_normal((6, 4)).astype(np.float32))
+        return [x], [tf.matmul(x, w, name="y")]
+
+    gfn, oracle = _freeze(build)
+    want = oracle(x_np)[0]
+    for mode in ("highest", "default"):
+        fn = translate_graph_def(
+            gfn.graph_def, gfn.input_names, gfn.output_names,
+            f32_precision=mode,
+        )
+        got = jax.jit(fn)(x_np)[0]
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+    with pytest.raises(ValueError, match="f32_precision"):
+        translate_graph_def(gfn.graph_def, gfn.input_names,
+                            gfn.output_names, f32_precision="hgihest")
+    with pytest.raises(ValueError, match="f32_precision"):
+        gfn.to_jax(prefer_native=False, f32_precision="bogus")
+
+
 def test_dynamic_reshape_from_traced_tensor_rejected():
     def build():
         x = v1.placeholder(tf.float32, [None, 4], name="x")
