@@ -36,12 +36,12 @@ def main() -> None:
     platform = jax.default_backend()
     on_accel = platform not in ("cpu",)
     batch = int(os.environ.get("BENCH_BATCH", 128 if on_accel else 8))
-    steps = int(os.environ.get("BENCH_STEPS", 30 if on_accel else 3))
+    steps = int(os.environ.get("BENCH_STEPS", 40 if on_accel else 3))
     # Per-dispatch program-launch overhead on the relayed chip is ~2.5 ms —
     # measurable against a 14 ms program — so the benched unit scans K
     # batches per dispatch (every image still processed exactly once per
     # step; PERF.md "scan-K" has the measurements).
-    scan_k = int(os.environ.get("BENCH_SCAN_K", 16 if on_accel else 1))
+    scan_k = int(os.environ.get("BENCH_SCAN_K", 24 if on_accel else 1))
     size = 299 if on_accel else 128  # CPU smoke keeps compile/runtime sane
 
     dtype = jnp.bfloat16 if on_accel else jnp.float32
@@ -50,17 +50,27 @@ def main() -> None:
     )
     # 'tf' preprocessing folded into the stem weights (exact — see
     # ops/fold.py + tests/ops/test_fold.py): the program eats raw pixels,
-    # saving one full-image elementwise pass per batch.
+    # saving one full-image elementwise pass per batch. On accelerators
+    # the branch-merged eval forward (models/inception_fused.py,
+    # oracle-tested identical) reads each mixed-block input once instead
+    # of once per 1x1 head (+1.9% measured on the v5e).
+    from sparkdl_tpu.models.inception_fused import (
+        fused_inception_v3_features,
+    )
     from sparkdl_tpu.ops.fold import fold_tf_preprocess
 
     variables = fold_tf_preprocess(variables)
     preprocess = PREPROCESSORS["identity"]
 
-    def featurize_one(x):
-        feats, _ = module.apply(
-            variables, preprocess(x.astype(dtype)), train=False
-        )
-        return feats.astype(jnp.float32)
+    if on_accel:
+        def featurize_one(x):
+            return fused_inception_v3_features(variables, x, dtype=dtype)
+    else:
+        def featurize_one(x):
+            feats, _ = module.apply(
+                variables, preprocess(x.astype(dtype)), train=False
+            )
+            return feats.astype(jnp.float32)
 
     if scan_k == 1:
         featurize = jax.jit(featurize_one)

@@ -1,0 +1,163 @@
+"""Branch-merged InceptionV3 eval forward (TPU inference fast path).
+
+Each Inception mixed block runs several 1x1 convs over the SAME input
+tensor (branch heads). XLA schedules them as separate convolutions, so the
+block input is read from HBM once per branch. This module evaluates the
+identical math with the branch-head kernels concatenated along the output
+axis — one bigger conv per head group (input read once, larger MXU op),
+then a channel split. Weights are the ordinary zoo ``variables``
+(models/inception.py construction order); kernels are concatenated at
+trace time (tiny, folded by XLA).
+
+Merged groups (all 1x1 stride-1 heads sharing the block input):
+  - inception-A x3: b1 / b5-reduce / b3-reduce
+  - inception-B x4: b1 / b7-reduce / b7dbl-reduce
+  - reduction-B:    b3-reduce / b7-reduce
+  - inception-C x2: b1 / b3-reduce / b3dbl-reduce
+
+Eval-only (BatchNorm running stats; training uses the canonical module).
+Exactness vs the module is oracle-tested in tests/models/test_fused.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from sparkdl_tpu.models.common import avg_pool_keras, global_avg_pool, max_pool
+
+_BN_EPS = 1e-3  # models/common.py _bn default, as InceptionV3 uses
+
+
+def _conv(x, kernel, strides=1, padding="SAME"):
+    return lax.conv_general_dilated(
+        x, kernel, (strides, strides), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _relu(x):
+    return jnp.maximum(x, 0)
+
+
+class _Flow:
+    """Reads conv/bn weights by the module's construction-order index."""
+
+    def __init__(self, variables, dtype):
+        self.p = variables["params"]
+        self.s = variables["batch_stats"]
+        self.dtype = dtype
+        self.i = 0
+
+    def take(self, n: int = 1):
+        idxs = list(range(self.i, self.i + n))
+        self.i += n
+        return idxs if n > 1 else idxs[0]
+
+    def kernel(self, i):
+        return self.p[f"conv{i:03d}"]["kernel"].astype(self.dtype)
+
+    def bn_consts(self, i):
+        """(scale r, shift) for eval BN: y = z*r + shift (scale-free BN)."""
+        bn, st = self.p[f"bn{i:03d}"], self.s[f"bn{i:03d}"]
+        r = lax.rsqrt(st["var"] + _BN_EPS)
+        shift = bn["bias"] - st["mean"] * r
+        return r.astype(self.dtype), shift.astype(self.dtype)
+
+    def cbr(self, x, i=None, strides=1, padding="SAME"):
+        """conv[i] + eval-BN[i] + relu (i defaults to the next index)."""
+        if i is None:
+            i = self.take()
+        # avg_pool_keras promotes to f32 (its non-pad divisor); keep the
+        # compute dtype stable into the conv
+        z = _conv(x.astype(self.dtype), self.kernel(i), strides, padding)
+        r, shift = self.bn_consts(i)
+        return _relu(z * r + shift)
+
+    def merged_heads(self, x, idxs):
+        """The 1x1 stride-1 heads ``idxs`` over ``x`` as ONE conv; returns
+        per-head outputs (post BN+relu), channel-split."""
+        kernels = [self.kernel(i) for i in idxs]
+        widths = [k.shape[-1] for k in kernels]
+        consts = [self.bn_consts(i) for i in idxs]
+        z = _conv(x, jnp.concatenate(kernels, axis=-1))
+        r = jnp.concatenate([c[0] for c in consts])
+        shift = jnp.concatenate([c[1] for c in consts])
+        z = _relu(z * r + shift)
+        outs, start = [], 0
+        for w in widths:
+            outs.append(z[..., start:start + w])
+            start += w
+        return outs
+
+
+def fused_inception_v3_features(variables, x, dtype=jnp.bfloat16):
+    """2048-d features, identical math to
+    ``InceptionV3(include_top=False).apply(variables, x, train=False)``
+    with branch heads merged. ``x``: [B, H, W, 3], already preprocessed
+    (or raw pixels if the variables were preprocess-folded, ops/fold.py).
+    """
+    f = _Flow(variables, dtype)
+    x = x.astype(dtype)
+
+    # -- stem ----------------------------------------------------------
+    x = f.cbr(x, strides=2, padding="VALID")
+    x = f.cbr(x, padding="VALID")
+    x = f.cbr(x)
+    x = max_pool(x, 3, 2, "VALID")
+    x = f.cbr(x, padding="VALID")
+    x = f.cbr(x, padding="VALID")
+    x = max_pool(x, 3, 2, "VALID")
+
+    # -- 3x inception-A (module order: b1, b5r, b5, b3r, b3a, b3b, bp) --
+    for _ in range(3):
+        idx = f.take(7)
+        b1, b5, b3 = f.merged_heads(x, [idx[0], idx[1], idx[3]])
+        b5 = f.cbr(b5, idx[2])
+        b3 = f.cbr(b3, idx[4])
+        b3 = f.cbr(b3, idx[5])
+        bp = f.cbr(avg_pool_keras(x, 3, 1, "SAME"), idx[6])
+        x = jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+    # -- reduction-A (b3s2, bdr, bd, bds2 — no mergeable heads) --------
+    b3 = f.cbr(x, strides=2, padding="VALID")
+    bd = f.cbr(x)
+    bd = f.cbr(bd)
+    bd = f.cbr(bd, strides=2, padding="VALID")
+    x = jnp.concatenate([b3, bd, max_pool(x, 3, 2, "VALID")], axis=-1)
+
+    # -- 4x inception-B (order: b1, b7r, b7a, b7b, bdr, bd1..bd4, bp) --
+    for _ in range(4):
+        idx = f.take(10)
+        b1, b7, bd = f.merged_heads(x, [idx[0], idx[1], idx[4]])
+        b7 = f.cbr(b7, idx[2])
+        b7 = f.cbr(b7, idx[3])
+        bd = f.cbr(bd, idx[5])
+        bd = f.cbr(bd, idx[6])
+        bd = f.cbr(bd, idx[7])
+        bd = f.cbr(bd, idx[8])
+        bp = f.cbr(avg_pool_keras(x, 3, 1, "SAME"), idx[9])
+        x = jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+    # -- reduction-B (order: b3r, b3s2, b7r, b7a, b7b, b7s2) -----------
+    idx = f.take(6)
+    b3, b7 = f.merged_heads(x, [idx[0], idx[2]])
+    b3 = f.cbr(b3, idx[1], strides=2, padding="VALID")
+    b7 = f.cbr(b7, idx[3])
+    b7 = f.cbr(b7, idx[4])
+    b7 = f.cbr(b7, idx[5], strides=2, padding="VALID")
+    x = jnp.concatenate([b3, b7, max_pool(x, 3, 2, "VALID")], axis=-1)
+
+    # -- 2x inception-C (order: b1, b3r, b3a, b3b, bdr, bd, bda, bdb, bp)
+    for _ in range(2):
+        idx = f.take(9)
+        b1, b3, bd = f.merged_heads(x, [idx[0], idx[1], idx[4]])
+        b3 = jnp.concatenate(
+            [f.cbr(b3, idx[2]), f.cbr(b3, idx[3])], axis=-1)
+        bd = f.cbr(bd, idx[5])
+        bd = jnp.concatenate(
+            [f.cbr(bd, idx[6]), f.cbr(bd, idx[7])], axis=-1)
+        bp = f.cbr(avg_pool_keras(x, 3, 1, "SAME"), idx[8])
+        x = jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+    return global_avg_pool(x).astype(jnp.float32)

@@ -1,0 +1,69 @@
+"""Branch-merged InceptionV3 eval oracle: fused forward must match the
+canonical Flax module on the same variables (identical math, rearranged
+into merged convs)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.inception_fused import fused_inception_v3_features
+from sparkdl_tpu.models.registry import build_flax_model
+
+
+@pytest.fixture(scope="module")
+def inception():
+    return build_flax_model("InceptionV3", weights=None, include_top=False)
+
+
+def test_fused_matches_module(inception):
+    module, variables = inception
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 96, 96, 3)), jnp.float32)
+
+    ref, _ = jax.jit(
+        lambda v, x: module.apply(v, x, train=False)
+    )(variables, x)
+    got = jax.jit(
+        lambda v, x: fused_inception_v3_features(v, x, dtype=jnp.float32)
+    )(variables, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=2e-4, rtol=1e-4
+    )
+
+
+def test_fused_walk_covers_all_94_convs(inception):
+    """The module has exactly 94 conv/bn pairs and the fused walk ends on
+    the last one (a missed or double-consumed index would misassign
+    weights — which the exact-match oracle above would also catch)."""
+    _, variables = inception
+    n_convs = sum(1 for k in variables["params"] if k.startswith("conv"))
+    assert n_convs == 94
+    assert "conv093" in variables["params"]
+    assert "conv094" not in variables["params"]
+
+
+def test_fused_with_preprocess_fold(inception):
+    """The bench path: folded variables + raw pixels through the fused
+    forward == preprocessed pixels through the canonical module."""
+    from sparkdl_tpu.ops.fold import fold_tf_preprocess
+    from sparkdl_tpu.ops.preprocess import preprocess_tf
+
+    module, variables = inception
+    folded = fold_tf_preprocess(variables)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(
+        rng.integers(0, 256, (2, 96, 96, 3)).astype(np.float32))
+
+    ref, _ = jax.jit(
+        lambda v, x: module.apply(v, preprocess_tf(x), train=False)
+    )(variables, x)
+    got = jax.jit(
+        lambda v, x: fused_inception_v3_features(v, x, dtype=jnp.float32)
+    )(folded, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=1e-3
+    )
