@@ -164,6 +164,11 @@ def _register_simple():
         "Floor": jnp.floor, "Ceil": jnp.ceil, "Round": jnp.round,
         "Erf": lax.erf, "Reciprocal": jnp.reciprocal,
         "LogicalNot": jnp.logical_not,
+        "Sin": jnp.sin, "Cos": jnp.cos, "Tan": jnp.tan,
+        "Asin": jnp.arcsin, "Acos": jnp.arccos, "Atan": jnp.arctan,
+        "Sinh": jnp.sinh, "Cosh": jnp.cosh,
+        "Expm1": jnp.expm1, "Rint": jnp.rint,
+        "IsFinite": jnp.isfinite, "IsNan": jnp.isnan, "IsInf": jnp.isinf,
     }
     for op, fn in unary.items():
         _op(op)(lambda xp, node, x, _fn=fn: _fn(x))
@@ -204,6 +209,42 @@ def _register_simple():
     _op("AddN", dual=True)(
         lambda xp, node, *xs: functools.reduce(xp.add, xs)
     )
+    _op("Atan2", dual=True)(
+        lambda xp, node, a, b: xp.arctan2(a, b)
+    )
+
+    @_op("Cumsum")
+    def _cumsum(xp, node, x, axis):
+        axis = int(_static(axis, node, "axis"))
+        if _attr(node, "exclusive", False) or _attr(node, "reverse", False):
+            raise GraphTranslationError(
+                f"node {node.name!r}: exclusive/reverse Cumsum unsupported"
+            )
+        return jnp.cumsum(x, axis=axis)
+
+    @_op("Cumprod")
+    def _cumprod(xp, node, x, axis):
+        axis = int(_static(axis, node, "axis"))
+        if _attr(node, "exclusive", False) or _attr(node, "reverse", False):
+            raise GraphTranslationError(
+                f"node {node.name!r}: exclusive/reverse Cumprod unsupported"
+            )
+        return jnp.cumprod(x, axis=axis)
+
+    @_op("OneHot")
+    def _onehot(xp, node, indices, depth, on_value, off_value):
+        depth = int(_static(depth, node, "depth"))
+        axis = _attr(node, "axis", -1)
+        oh = jax.nn.one_hot(indices, depth, axis=axis)
+        # where(), not arithmetic: exact for every on/off dtype incl. bool
+        return jnp.where(oh != 0, jnp.asarray(on_value),
+                         jnp.asarray(off_value))
+
+    @_op("TopKV2")
+    def _topk(xp, node, x, k):
+        k = int(_static(k, node, "k"))
+        values, indices = jax.lax.top_k(x, k)
+        return values, indices.astype(np.int32)
     _op("Select")(lambda xp, node, c, a, b: jnp.where(c, a, b))
     _op("SelectV2")(lambda xp, node, c, a, b: jnp.where(c, a, b))
     _op("ClipByValue")(

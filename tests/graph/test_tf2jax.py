@@ -244,23 +244,58 @@ def test_gather_argmax_cast():
 
 
 def test_untranslatable_op_reported_and_falls_back_to_call_tf():
-    x_np = rng.standard_normal((3, 3)).astype(np.float32)
+    x_np = (np.eye(3) * 2 + rng.standard_normal((3, 3)) * 0.1).astype(
+        np.float32)
 
     def build():
-        x = v1.placeholder(tf.float32, [None, 3], name="x")
-        # Cumsum: deliberately outside the native surface (for now)
-        y = tf.cumsum(x, axis=1, name="y")
+        x = v1.placeholder(tf.float32, [3, 3], name="x")
+        # MatrixInverse: outside the native surface
+        y = tf.linalg.inv(x, name="y")
         return [x], [y]
 
     gfn, oracle = _freeze(build)
-    assert untranslatable_ops(gfn.graph_def) == ["Cumsum"]
-    with pytest.raises(GraphTranslationError, match="Cumsum"):
+    assert untranslatable_ops(gfn.graph_def) == ["MatrixInverse"]
+    with pytest.raises(GraphTranslationError, match="MatrixInverse"):
         translate_graph_def(gfn.graph_def, gfn.input_names,
                             gfn.output_names)
     # public surface: falls back to the call_tf lowering (CPU suite: works)
     fn = gfn.to_jax()
     got = fn(x_np)[0]
     np.testing.assert_allclose(np.asarray(got), oracle(x_np)[0], atol=1e-5)
+
+
+def test_cumsum_onehot_topk_trig():
+    x_np = rng.standard_normal((4, 6)).astype(np.float32)
+
+    def build():
+        x = v1.placeholder(tf.float32, [None, 6], name="x")
+        c = tf.cumsum(x, axis=1)
+        idx = tf.argmax(x, axis=1, output_type=tf.int32)
+        oh = tf.one_hot(idx, 6, on_value=2.0, off_value=-1.0)
+        oh_bool = tf.one_hot(idx, 6, on_value=True, off_value=False,
+                             dtype=tf.bool)
+        vals, inds = tf.math.top_k(x, k=3)
+        trig = tf.sin(x) + tf.cos(x) * tf.atan2(x, 1.0 + tf.abs(x))
+        return [x], [c, oh, tf.cast(oh_bool, tf.float32), vals,
+                     tf.cast(inds, tf.float32), trig]
+
+    _check(build, x_np)
+
+
+def test_exclusive_cumsum_attr_rejected_then_falls_back():
+    x_np = rng.standard_normal((2, 5)).astype(np.float32)
+
+    def build():
+        x = v1.placeholder(tf.float32, [None, 5], name="x")
+        y = tf.cumsum(x, axis=1, exclusive=True, name="y")
+        return [x], [y]
+
+    gfn, oracle = _freeze(build)
+    assert untranslatable_ops(gfn.graph_def) == []  # name covered
+    # attr gap -> sticky call_tf fallback at first call (CPU: works)
+    fn = gfn.to_jax()
+    np.testing.assert_allclose(
+        np.asarray(fn(x_np)[0]), oracle(x_np)[0], atol=1e-6)
 
 
 def test_f32_precision_knob():
