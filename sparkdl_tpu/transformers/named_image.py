@@ -69,10 +69,25 @@ def _named_model_runner(
     )
     preprocess = PREPROCESSORS[get_entry(model_name).preprocess]
 
-    def apply_fn(batch):
-        x = preprocess(batch["img"])
-        features, probs = module.apply(variables, x, train=False)
-        return features if head == "features" else probs
+    if model_name == "InceptionV3" and head == "features":
+        # Featurization fast path: branch-merged eval forward — identical
+        # math (oracle-tested, models/inception_fused.py), each mixed
+        # block's input read once instead of once per 1x1 head.
+        from sparkdl_tpu.models.inception_fused import (
+            fused_inception_v3_features,
+        )
+
+        def apply_fn(batch):
+            import jax.numpy as jnp
+
+            return fused_inception_v3_features(
+                variables, preprocess(batch["img"]), dtype=jnp.float32
+            )
+    else:
+        def apply_fn(batch):
+            x = preprocess(batch["img"])
+            features, probs = module.apply(variables, x, train=False)
+            return features if head == "features" else probs
 
     return BatchedRunner(apply_fn, batch_size=batch_size)
 
