@@ -36,12 +36,12 @@ def main() -> None:
     platform = jax.default_backend()
     on_accel = platform not in ("cpu",)
     batch = int(os.environ.get("BENCH_BATCH", 128 if on_accel else 8))
-    steps = int(os.environ.get("BENCH_STEPS", 40 if on_accel else 3))
+    steps = int(os.environ.get("BENCH_STEPS", 50 if on_accel else 3))
     # Per-dispatch program-launch overhead on the relayed chip is ~2.5 ms —
     # measurable against a 14 ms program — so the benched unit scans K
     # batches per dispatch (every image still processed exactly once per
     # step; PERF.md "scan-K" has the measurements).
-    scan_k = int(os.environ.get("BENCH_SCAN_K", 24 if on_accel else 1))
+    scan_k = int(os.environ.get("BENCH_SCAN_K", 32 if on_accel else 1))
     size = 299 if on_accel else 128  # CPU smoke keeps compile/runtime sane
 
     dtype = jnp.bfloat16 if on_accel else jnp.float32
