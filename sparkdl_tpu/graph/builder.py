@@ -91,7 +91,8 @@ class GraphFunction:
         if validate:
             from sparkdl_tpu.graph.op_surface import validate_graph_def
 
-            validate_graph_def(self.graph_def)
+            validate_graph_def(self.graph_def,
+                               output_names=self.output_names)
         gdef = self.graph_def
         in_names = list(self.input_names)
         out_names = list(self.output_names)
@@ -128,14 +129,18 @@ class GraphFunction:
             untranslatable_ops,
         )
 
-        if untranslatable_ops(gdef):
+        if untranslatable_ops(gdef, output_names=out_names):
             return make_call_tf()
 
         # Op names are all covered, but an ATTR combination may still be
-        # outside the translation surface (NCHW convs, ellipsis-mask
-        # slices, ...), which only surfaces when the translator walks the
+        # outside the translation surface (NCHW convs, align-corners
+        # resizes, ...), which only surfaces when the translator walks the
         # graph with real inputs. Fall back to call_tf at that point, once,
-        # so such graphs keep working wherever TF can compile them.
+        # so such graphs keep working wherever TF can compile them. The
+        # caught set is wider than GraphTranslationError because translator
+        # internals can surface unsupported patterns as TypeError/
+        # ValueError/IndexError (shape math, numpy conversion); errors
+        # raised by the fallback itself propagate.
         native_fn = translate_graph_def(
             gdef, in_names, out_names, f32_precision=f32_precision
         )
@@ -148,9 +153,16 @@ class GraphFunction:
                 out = native_fn(*arrays)
                 chosen.append(native_fn)
                 return out
-            except GraphTranslationError:
-                chosen.append(make_call_tf())
-                return chosen[0](*arrays)
+            except (GraphTranslationError, TypeError, ValueError,
+                    IndexError, NotImplementedError):
+                # latch the fallback only once it has actually produced a
+                # result — a user-input error (bad arity/shape) raises from
+                # BOTH paths and must not permanently downgrade the
+                # function to call_tf
+                fallback = make_call_tf()
+                out = fallback(*arrays)
+                chosen.append(fallback)
+                return out
 
         return fn
 

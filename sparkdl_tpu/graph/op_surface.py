@@ -140,12 +140,48 @@ def _referenced_functions(nodes) -> set[str]:
     return names
 
 
-def scan_graph_def(graph_def: Any) -> list[tuple[str, str, str]]:
+def _reachable_nodes(graph_def, output_names) -> list:
+    """Main-graph nodes reachable from ``output_names`` via DATA edges.
+
+    Control edges (``^dep``) are deliberately not followed: the native
+    translator ignores them (frozen graphs carry no state), and a dead
+    Assert/Print hooked on only by control dependency is executable by the
+    call_tf fallback anyway — scanning it would reject graphs both paths
+    can in fact run.
+    """
+    by_name = {n.name: n for n in graph_def.node}
+    pending = [name.split(":")[0].lstrip("^") for name in output_names]
+    seen: set[str] = set()
+    reached = []
+    while pending:
+        cur = pending.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        node = by_name.get(cur)
+        if node is None:
+            continue  # missing node: translate_graph_def reports it better
+        reached.append(node)
+        for inp in node.input:
+            if inp.startswith("^"):
+                continue
+            pending.append(inp.split(":")[0])
+    return reached
+
+
+def scan_graph_def(
+    graph_def: Any, output_names: "list[str] | None" = None
+) -> list[tuple[str, str, str]]:
     """All (node_name, op, reason) violations in ``graph_def`` and in the
     function-library bodies REACHABLE from it (defun bodies can hide host
     ops). Unreachable library functions are ignored: TF2 SavedModels keep
     dead ``__inference__traced_save/restore`` machinery in the library,
     and dead save/restore ops can't hurt a program that never calls them.
+
+    When ``output_names`` is given, the main-graph scan is likewise
+    restricted to the subgraph feeding those outputs, so unpruned frozen
+    GraphDefs carrying dead Assert/SaveV2/Print nodes validate the same
+    way the pruned ones do (`strip_and_freeze_upto` would drop them).
     """
     violations = []
 
@@ -155,10 +191,14 @@ def scan_graph_def(graph_def: Any) -> list[tuple[str, str, str]]:
             if reason is not None:
                 violations.append((where + n.name, n.op, reason))
 
-    scan_nodes(graph_def.node)
+    if output_names is not None:
+        main_nodes = _reachable_nodes(graph_def, output_names)
+    else:
+        main_nodes = list(graph_def.node)
+    scan_nodes(main_nodes)
 
     by_name = {fn.signature.name: fn for fn in graph_def.library.function}
-    pending = _referenced_functions(graph_def.node)
+    pending = _referenced_functions(main_nodes)
     seen: set[str] = set()
     while pending:
         name = pending.pop()
@@ -173,10 +213,13 @@ def scan_graph_def(graph_def: Any) -> list[tuple[str, str, str]]:
     return violations
 
 
-def validate_graph_def(graph_def: Any) -> None:
+def validate_graph_def(
+    graph_def: Any, output_names: "list[str] | None" = None
+) -> None:
     """Raise :class:`UnsupportedGraphOpsError` if the graph contains ops
     that can never compile; silently pass otherwise (XLA remains the final
-    authority at trace time)."""
-    violations = scan_graph_def(graph_def)
+    authority at trace time). ``output_names`` restricts the scan to the
+    output-feeding subgraph."""
+    violations = scan_graph_def(graph_def, output_names=output_names)
     if violations:
         raise UnsupportedGraphOpsError(violations)

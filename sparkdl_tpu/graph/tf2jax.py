@@ -213,23 +213,41 @@ def _register_simple():
         lambda xp, node, a, b: xp.arctan2(a, b)
     )
 
+    def _cumulative(node, x, axis, cum_fn, init):
+        """TF cumsum/cumprod semantics incl. exclusive/reverse attrs.
+
+        reverse: accumulate from the end (flip, scan, flip back);
+        exclusive: shift the inclusive scan one step, seeding with the
+        identity element — both applied in the flipped orientation so the
+        combination matches TF ([b+c, c, 0]-style).
+        """
+        exclusive = _attr(node, "exclusive", False)
+        reverse = _attr(node, "reverse", False)
+        if reverse:
+            x = jnp.flip(x, axis)
+        out = cum_fn(x, axis=axis)
+        if exclusive:
+            n = x.shape[axis]
+            seed_shape = list(x.shape)
+            seed_shape[axis] = 1
+            seed = jnp.full(seed_shape, init, dtype=out.dtype)
+            out = jnp.concatenate(
+                [seed, jax.lax.slice_in_dim(out, 0, n - 1, axis=axis)],
+                axis=axis,
+            )
+        if reverse:
+            out = jnp.flip(out, axis)
+        return out
+
     @_op("Cumsum")
     def _cumsum(xp, node, x, axis):
         axis = int(_static(axis, node, "axis"))
-        if _attr(node, "exclusive", False) or _attr(node, "reverse", False):
-            raise GraphTranslationError(
-                f"node {node.name!r}: exclusive/reverse Cumsum unsupported"
-            )
-        return jnp.cumsum(x, axis=axis)
+        return _cumulative(node, x, axis, jnp.cumsum, 0)
 
     @_op("Cumprod")
     def _cumprod(xp, node, x, axis):
         axis = int(_static(axis, node, "axis"))
-        if _attr(node, "exclusive", False) or _attr(node, "reverse", False):
-            raise GraphTranslationError(
-                f"node {node.name!r}: exclusive/reverse Cumprod unsupported"
-            )
-        return jnp.cumprod(x, axis=axis)
+        return _cumulative(node, x, axis, jnp.cumprod, 1)
 
     @_op("OneHot")
     def _onehot(xp, node, indices, depth, on_value, off_value):
@@ -245,7 +263,16 @@ def _register_simple():
         k = int(_static(k, node, "k"))
         values, indices = jax.lax.top_k(x, k)
         return values, indices.astype(np.int32)
-    _op("Select")(lambda xp, node, c, a, b: jnp.where(c, a, b))
+    @_op("Select")
+    def _select_v1(xp, node, c, a, b):
+        # TF Select (v1) broadcasts a rank-1 condition along the LEADING
+        # axis of higher-rank operands; numpy/jnp broadcast trailing axes,
+        # so reshape cond to (-1, 1, ..., 1) for that case.
+        c_nd, a_nd = np.ndim(c), max(np.ndim(a), np.ndim(b))
+        if c_nd == 1 and a_nd > 1:
+            c = jnp.reshape(c, (-1,) + (1,) * (a_nd - 1))
+        return jnp.where(c, a, b)
+
     _op("SelectV2")(lambda xp, node, c, a, b: jnp.where(c, a, b))
     _op("ClipByValue")(
         lambda xp, node, x, lo, hi: jnp.clip(x, lo, hi)
@@ -386,8 +413,10 @@ def _register_simple():
         @_op(op)
         def _reduce(xp, node, x, axes, _fn=fn):
             axes = _static(axes, node, "reduction axes")
+            # axis=() is a no-op reduction in TF (identity) and numpy/jnp
+            # agree — do NOT collapse an empty list to axis=None (all axes)
             axis = tuple(int(a) for a in np.atleast_1d(axes))
-            return _fn(x, axis=axis or None,
+            return _fn(x, axis=axis,
                        keepdims=_attr(node, "keep_dims", False))
 
     @_op("ArgMax")
@@ -500,13 +529,18 @@ def _register_simple():
         ell = _attr(node, "ellipsis_mask", 0)
         na = _attr(node, "new_axis_mask", 0)
         sa = _attr(node, "shrink_axis_mask", 0)
-        if ell or na:
-            raise GraphTranslationError(
-                f"node {node.name!r}: StridedSlice ellipsis/new-axis "
-                "masks unsupported"
-            )
+        # The sparse spec maps 1:1 onto a numpy/jnp index tuple: mask bit i
+        # selects how position i of the spec is interpreted; begin/end/
+        # strides values at ellipsis/new-axis positions are ignored (TF
+        # ignores them too).
         idx = []
         for i in range(len(begin)):
+            if ell & (1 << i):
+                idx.append(Ellipsis)
+                continue
+            if na & (1 << i):
+                idx.append(None)
+                continue
             if sa & (1 << i):
                 idx.append(int(begin[i]))
                 continue
@@ -517,13 +551,30 @@ def _register_simple():
 
     @_op("GatherV2", dual=True)
     def _gather(xp, node, params, indices, axis):
-        if _attr(node, "batch_dims", 0):
-            raise GraphTranslationError(
-                f"node {node.name!r}: GatherV2 with batch_dims != 0 "
-                "unsupported"
-            )
         axis = int(_static(axis, node, "axis"))
-        return xp.take(params, indices, axis=axis)
+        bd = int(_attr(node, "batch_dims", 0))
+        if bd < 0:
+            bd += np.ndim(indices)
+        if axis < 0:
+            axis += np.ndim(params)
+        if bd == 0:
+            return xp.take(params, indices, axis=axis)
+        # batch_dims>0: the leading bd axes of params/indices are aligned
+        # batches; peel them with vmap (numpy static inputs: a python map —
+        # static gathers in shape-math chains are tiny)
+        def _bd_gather(p, i, a, b):
+            if b == 0:
+                return xp.take(p, i, axis=a)
+            if xp is np:
+                return np.stack([
+                    _bd_gather(pp, ii, a - 1, b - 1)
+                    for pp, ii in zip(p, i)
+                ])
+            return jax.vmap(
+                lambda pp, ii: _bd_gather(pp, ii, a - 1, b - 1)
+            )(p, i)
+
+        return _bd_gather(params, indices, axis, bd)
 
     @_op("Tile", dual=True)
     def _tile(xp, node, x, multiples):
@@ -630,13 +681,38 @@ _register_simple()
 # --------------------------------------------------------------------------
 
 
-def untranslatable_ops(graph_def) -> "list[str]":
-    """Ops in ``graph_def`` that the native translator does NOT cover
-    (empty list == fully translatable). Const/Placeholder/NoOp are
-    structural and always fine."""
+def _reachable(graph_def, output_names) -> list:
+    """Nodes feeding ``output_names`` via data edges (control edges are
+    ignored — same discipline as the translation walk)."""
+    by_name = {n.name: n for n in graph_def.node}
+    pending = [tfx.op_name(n) for n in output_names]
+    seen: set[str] = set()
+    out = []
+    while pending:
+        cur = pending.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        node = by_name.get(cur)
+        if node is None:
+            continue  # translate_graph_def reports missing nodes properly
+        out.append(node)
+        for inp in node.input:
+            if not inp.startswith("^"):
+                pending.append(tfx.op_name(inp))
+    return out
+
+
+def untranslatable_ops(graph_def, output_names=None) -> "list[str]":
+    """Ops that the native translator does NOT cover (empty list == fully
+    translatable). Const/Placeholder/NoOp are structural and always fine.
+    With ``output_names``, only the output-feeding subgraph is scanned, so
+    unpruned graphs carrying dead nodes keep the native path."""
     structural = {"Const", "Placeholder", "NoOp"}
+    nodes = (graph_def.node if output_names is None
+             else _reachable(graph_def, output_names))
     return sorted({
-        n.op for n in graph_def.node
+        n.op for n in nodes
         if n.op not in structural and n.op not in _TRANSLATORS
     })
 
@@ -664,7 +740,7 @@ def translate_graph_def(
         )
 
     nodes = {n.name: n for n in graph_def.node}
-    missing = untranslatable_ops(graph_def)
+    missing = untranslatable_ops(graph_def, output_names=output_names)
     if missing:
         raise GraphTranslationError(
             f"graph has ops outside the native translation surface: "

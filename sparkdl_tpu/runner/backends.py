@@ -333,10 +333,20 @@ class _LogRelay:
         self._srv.settimeout(0.2)
         self.address = f"{socket.gethostname()}:{self._srv.getsockname()[1]}"
         self._closing = threading.Event()
-        self._threads: list[threading.Thread] = []
-        t = threading.Thread(target=self._accept_loop, daemon=True)
-        t.start()
-        self._threads.append(t)
+        #: live pump threads only — each pump removes itself on disconnect,
+        #: so a long job's worth of short-lived connections does not
+        #: accumulate one dead Thread object per connection
+        self._pumps: "set[threading.Thread]" = set()
+        self._pumps_lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def live_pumps(self) -> int:
+        """Number of currently-connected executor streams."""
+        with self._pumps_lock:
+            return len(self._pumps)
 
     def _accept_loop(self) -> None:
         while not self._closing.is_set():
@@ -349,15 +359,20 @@ class _LogRelay:
             t = threading.Thread(
                 target=self._pump, args=(conn,), daemon=True
             )
+            with self._pumps_lock:
+                self._pumps.add(t)
             t.start()
-            self._threads.append(t)
 
     def _pump(self, conn: socket.socket) -> None:
-        with conn, conn.makefile("r", errors="replace") as f:
-            for line in f:
-                line = line.rstrip("\n")
-                self.lines.append(line)
-                self._sink(line)
+        try:
+            with conn, conn.makefile("r", errors="replace") as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    self.lines.append(line)
+                    self._sink(line)
+        finally:
+            with self._pumps_lock:
+                self._pumps.discard(threading.current_thread())
 
     def close(self) -> None:
         self._closing.set()
@@ -365,7 +380,10 @@ class _LogRelay:
             self._srv.close()
         except OSError:
             pass
-        for t in self._threads:
+        self._accept_thread.join(timeout=2)
+        with self._pumps_lock:
+            pumps = list(self._pumps)
+        for t in pumps:
             t.join(timeout=2)
 
 

@@ -120,6 +120,29 @@ def test_validate_false_bypasses_prescreen():
     assert callable(fn)
 
 
+def test_dead_nodes_ignored_when_outputs_given():
+    """An unpruned GraphDef carrying a dead Assert validates when the scan
+    is restricted to the output-feeding subgraph (to_jax passes
+    output_names) — consistent with the module's reachability carve-out
+    for library functions; the full-graph scan still flags it."""
+    with IsolatedSession() as sess:
+        x = tf.compat.v1.placeholder(tf.float32, [None, 2], name="x")
+        tf.compat.v1.Assert(tf.constant(True), [tf.constant(1.0)],
+                            name="dead_assert")
+        y = tf.identity(x * 2.0, name="y")
+        gfn = sess.asGraphFunction([x], [y], strip_and_freeze=False)
+
+    assert any(op == "Assert"
+               for _, op, _ in scan_graph_def(gfn.graph_def))
+    assert scan_graph_def(gfn.graph_def,
+                          output_names=gfn.output_names) == []
+    # and the public ingestion path accepts + executes the graph
+    fn = gfn.to_jax()
+    out = fn(np.ones((2, 2), np.float32))[0]
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full((2, 2), 2.0), rtol=1e-6)
+
+
 def test_violation_list_capped_in_message():
     def build(sess):
         outs = []

@@ -210,24 +210,48 @@ def test_resize_bilinear_tf1_legacy_convention_matches_tf():
 
 
 def test_attr_level_gap_falls_back_to_call_tf_at_first_call():
-    """Ops all covered by name, but an attr (ellipsis-mask StridedSlice)
-    is outside the native surface: to_jax must fall back to the call_tf
+    """Ops all covered by name, but an attr (align_corners resize) is
+    outside the native surface: to_jax must fall back to the call_tf
     lowering on first call instead of raising (CPU suite: works)."""
-    x_np = rng.standard_normal((3, 4, 5)).astype(np.float32)
+    x_np = rng.standard_normal((2, 8, 8, 3)).astype(np.float32)
 
     def build():
-        x = v1.placeholder(tf.float32, [None, 4, 5], name="x")
-        y = tf.identity(x[..., 0], name="y")  # ellipsis_mask slice
+        x = v1.placeholder(tf.float32, [None, 8, 8, 3], name="x")
+        y = tf.compat.v1.image.resize_bilinear(
+            x, [16, 16], align_corners=True, name="y")
         return [x], [y]
 
     gfn, oracle = _freeze(build)
     assert untranslatable_ops(gfn.graph_def) == []  # names all covered
     fn = gfn.to_jax()
     got = fn(x_np)[0]
-    np.testing.assert_allclose(np.asarray(got), oracle(x_np)[0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got), oracle(x_np)[0], atol=1e-5)
     # and the fallback is sticky: second call reuses it
     got2 = fn(x_np)[0]
-    np.testing.assert_allclose(np.asarray(got2), oracle(x_np)[0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got2), oracle(x_np)[0], atol=1e-5)
+
+
+def test_translator_typeerror_falls_back_to_call_tf(monkeypatch):
+    """Translator internals may surface unsupported patterns as TypeError/
+    ValueError rather than GraphTranslationError; the runtime fallback must
+    still engage rather than failing a graph call_tf can run."""
+    from sparkdl_tpu.graph import tf2jax as t2j
+
+    x_np = rng.standard_normal((2, 5)).astype(np.float32)
+
+    def build():
+        x = v1.placeholder(tf.float32, [None, 5], name="x")
+        return [x], [tf.tanh(x, name="y")]
+
+    gfn, oracle = _freeze(build)
+
+    def boom(xp, node, x):
+        raise TypeError("synthetic translator bug")
+
+    monkeypatch.setitem(t2j._TRANSLATORS, "Tanh", boom)
+    fn = gfn.to_jax()
+    np.testing.assert_allclose(
+        np.asarray(fn(x_np)[0]), oracle(x_np)[0], atol=1e-6)
 
 
 def test_gather_argmax_cast():
@@ -282,20 +306,95 @@ def test_cumsum_onehot_topk_trig():
     _check(build, x_np)
 
 
-def test_exclusive_cumsum_attr_rejected_then_falls_back():
-    x_np = rng.standard_normal((2, 5)).astype(np.float32)
+@pytest.mark.parametrize("exclusive", [False, True])
+@pytest.mark.parametrize("reverse", [False, True])
+@pytest.mark.parametrize("axis", [0, 1])
+def test_cumsum_cumprod_exclusive_reverse(exclusive, reverse, axis):
+    """All four exclusive×reverse combinations translate natively and
+    match the TF oracle, on both axes."""
+    x_np = (rng.standard_normal((3, 5)).astype(np.float32) * 0.5)
 
     def build():
-        x = v1.placeholder(tf.float32, [None, 5], name="x")
-        y = tf.cumsum(x, axis=1, exclusive=True, name="y")
-        return [x], [y]
+        x = v1.placeholder(tf.float32, [3, 5], name="x")
+        s = tf.cumsum(x, axis=axis, exclusive=exclusive, reverse=reverse)
+        p = tf.math.cumprod(1.0 + x * 0.1, axis=axis,
+                            exclusive=exclusive, reverse=reverse)
+        return [x], [s, p]
 
-    gfn, oracle = _freeze(build)
-    assert untranslatable_ops(gfn.graph_def) == []  # name covered
-    # attr gap -> sticky call_tf fallback at first call (CPU: works)
-    fn = gfn.to_jax()
-    np.testing.assert_allclose(
-        np.asarray(fn(x_np)[0]), oracle(x_np)[0], atol=1e-6)
+    _check(build, x_np)
+
+
+def test_gather_batch_dims():
+    """GatherV2 with batch_dims=1 (the ragged-free embedding-lookup shape
+    modern zoo graphs emit) translates natively."""
+    params = rng.standard_normal((4, 7, 3)).astype(np.float32)
+    idx = rng.integers(0, 7, size=(4, 5)).astype(np.int32)
+
+    def build():
+        p = v1.placeholder(tf.float32, [4, 7, 3], name="p")
+        i = v1.placeholder(tf.int32, [4, 5], name="i")
+        y = tf.gather(p, i, axis=1, batch_dims=1, name="y")
+        return [p, i], [y]
+
+    _check(build, params, idx)
+
+
+def test_gather_batch_dims_deeper_axis():
+    params = rng.standard_normal((2, 3, 6, 4)).astype(np.float32)
+    idx = rng.integers(0, 6, size=(2, 3, 2)).astype(np.int32)
+
+    def build():
+        p = v1.placeholder(tf.float32, [2, 3, 6, 4], name="p")
+        i = v1.placeholder(tf.int32, [2, 3, 2], name="i")
+        y = tf.gather(p, i, axis=2, batch_dims=2, name="y")
+        return [p, i], [y]
+
+    _check(build, params, idx)
+
+
+def test_strided_slice_ellipsis_and_new_axis():
+    x_np = rng.standard_normal((3, 4, 5)).astype(np.float32)
+
+    def build():
+        x = v1.placeholder(tf.float32, [3, 4, 5], name="x")
+        a = tf.identity(x[..., 0], name="a")          # ellipsis + shrink
+        b = tf.identity(x[:, tf.newaxis, 1:], name="b")  # new axis + slice
+        c = tf.identity(x[..., 1:3, tf.newaxis], name="c")
+        return [x], [a, b, c]
+
+    _check(build, x_np)
+
+
+def test_select_v1_rank1_cond_broadcasts_leading_axis():
+    """TF Select (v1) broadcasts a rank-1 cond along the LEADING axis;
+    the square case (n==trailing dim) silently selects along the wrong
+    axis if translated as plain where()."""
+    c_np = np.array([True, False, True], np.bool_)
+    a_np = rng.standard_normal((3, 3)).astype(np.float32)
+    b_np = rng.standard_normal((3, 3)).astype(np.float32)
+
+    def build():
+        c = v1.placeholder(tf.bool, [3], name="c")
+        a = v1.placeholder(tf.float32, [3, 3], name="a")
+        b = v1.placeholder(tf.float32, [3, 3], name="b")
+        y = tf.raw_ops.Select(condition=c, x=a, y=b, name="y")
+        return [c, a, b], [y]
+
+    _check(build, c_np, a_np, b_np)
+
+
+def test_reduction_empty_axis_list_is_identity():
+    """TF reduce_*(x, axis=[]) is the identity (keepdims irrelevant);
+    collapsing an empty axis list to 'reduce all' silently diverges."""
+    x_np = rng.standard_normal((3, 4)).astype(np.float32)
+
+    def build():
+        x = v1.placeholder(tf.float32, [3, 4], name="x")
+        m = tf.reduce_mean(x, axis=[], name="m")
+        s = tf.reduce_sum(x, axis=[], name="s")
+        return [x], [m, s]
+
+    _check(build, x_np)
 
 
 def test_f32_precision_knob():
