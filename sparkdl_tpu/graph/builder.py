@@ -126,10 +126,17 @@ class GraphFunction:
         from sparkdl_tpu.graph.tf2jax import (
             GraphTranslationError,
             translate_graph_def,
-            untranslatable_ops,
         )
 
-        if untranslatable_ops(gdef, output_names=out_names):
+        # translate_graph_def inlines TF2 function-call sites itself and
+        # raises GraphTranslationError when any op is outside the native
+        # surface — one scan, one contract. call_tf (below) keeps the
+        # ORIGINAL graph: a TF session executes function calls natively.
+        try:
+            native_fn = translate_graph_def(
+                gdef, in_names, out_names, f32_precision=f32_precision
+            )
+        except GraphTranslationError:
             return make_call_tf()
 
         # Op names are all covered, but an ATTR combination may still be
@@ -141,9 +148,6 @@ class GraphFunction:
         # internals can surface unsupported patterns as TypeError/
         # ValueError/IndexError (shape math, numpy conversion); errors
         # raised by the fallback itself propagate.
-        native_fn = translate_graph_def(
-            gdef, in_names, out_names, f32_precision=f32_precision
-        )
         chosen: list = []
 
         def fn(*arrays):

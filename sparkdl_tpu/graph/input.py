@@ -104,7 +104,18 @@ class TFInputGraph:
         cls, saved_model_dir: str, tag_set: str = _SERVE_TAG,
         feed_names: Sequence[str] = (), fetch_names: Sequence[str] = (),
     ) -> "TFInputGraph":
-        """From a SavedModel with explicit feed/fetch tensor names."""
+        """From a SavedModel with explicit feed/fetch tensor names.
+
+        TF2 (object-graph) SavedModels freeze through the TF2 loader; the
+        feed/fetch names then address the FROZEN graph of the serving
+        signature (arg placeholders like ``"x:0"`` plus the inlined body's
+        hierarchical op names), since TF2 variables cannot restore into a
+        v1 session.
+        """
+        if _is_tf2_saved_model(saved_model_dir, tag_set):
+            return _from_tf2_saved_model(
+                saved_model_dir, tag_set, feed_names, fetch_names, None
+            )
         with _loaded_saved_model(saved_model_dir, tag_set) as (issn, _meta):
             return _from_session(issn.graph, issn.sess, feed_names, fetch_names, None)
 
@@ -113,13 +124,119 @@ class TFInputGraph:
         cls, saved_model_dir: str, tag_set: str = _SERVE_TAG,
         signature_def_key: str = _SERVING,
     ) -> "TFInputGraph":
-        """From a SavedModel, endpoints resolved through its signature_def."""
+        """From a SavedModel, endpoints resolved through its signature_def.
+
+        Handles both generations: TF1-style SavedModels load into a v1
+        session and freeze there; TF2 (object-graph) SavedModels — what
+        ``tf.saved_model.save``/Keras export — load through the TF2 loader
+        and freeze via ``convert_variables_to_constants_v2``, which also
+        inlines the ``tf.function`` call tree, so the result translates
+        natively on TPU.
+        """
+        if _is_tf2_saved_model(saved_model_dir, tag_set):
+            return _from_tf2_saved_model(
+                saved_model_dir, tag_set, None, None, signature_def_key
+            )
         with _loaded_saved_model(saved_model_dir, tag_set) as (issn, meta):
             sig = _signature(meta, signature_def_key)
             return _from_session(issn.graph, issn.sess, None, None, sig)
 
 
 # -- internals -------------------------------------------------------------
+
+def _is_tf2_saved_model(saved_model_dir: str, tag_set: str) -> bool:
+    """True when the tagged MetaGraph carries a TF2 object graph (saved by
+    ``tf.saved_model.save`` / Keras export): its variables live in the
+    object graph and cannot restore into a v1 session."""
+    require_tf()
+    from tensorflow.python.saved_model import loader_impl
+
+    try:
+        sm = loader_impl.parse_saved_model(saved_model_dir)
+    except Exception:
+        return False
+    tags = {t for t in (tag_set or "").split(",") if t}
+    for mg in sm.meta_graphs:
+        if tags <= set(mg.meta_info_def.tags):
+            return len(mg.object_graph_def.nodes) > 0
+    return False
+
+
+def _from_tf2_saved_model(
+    saved_model_dir: str, tag_set: str,
+    feed_names, fetch_names, signature_def_key: "str | None",
+) -> TFInputGraph:
+    """TF2 loader + ``convert_variables_to_constants_v2`` freeze.
+
+    The v2 freeze inlines the traced ``tf.function`` call tree
+    (PartitionedCall sites and their library bodies) while folding
+    variables, so the stored GraphDef is flat and native-translatable —
+    the TPU-honest form of the reference's "run any SavedModel" promise
+    (SURVEY.md 2.7).
+    """
+    tf = require_tf()
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    tags = [t for t in (tag_set or "").split(",") if t] or None
+    obj = tf.saved_model.load(saved_model_dir, tags=tags)
+    sigs = dict(obj.signatures)
+    if signature_def_key is not None:
+        if signature_def_key not in sigs:
+            raise KeyError(
+                f"signature_def {signature_def_key!r} not found; "
+                f"available: {sorted(sigs)}"
+            )
+        key = signature_def_key
+    elif _SERVING in sigs:
+        key = _SERVING
+    elif len(sigs) == 1:
+        key = next(iter(sigs))
+    else:
+        raise ValueError(
+            "TF2 SavedModel with multiple signatures and no "
+            f"signature_def_key; available: {sorted(sigs)}"
+        )
+
+    cf = sigs[key]
+    frozen = convert_variables_to_constants_v2(cf)
+    gdef = frozen.graph.as_graph_def(add_shapes=True)
+
+    # signature key -> frozen tensor name. Inputs: the signature wrapper's
+    # arg specs are named by signature key and flatten in the same order
+    # as the frozen placeholders. Outputs: structured_outputs of the
+    # ORIGINAL signature fn keeps the key->tensor dict; the frozen fn's
+    # outputs follow the same (key-sorted) flatten order.
+    in_specs = [
+        s for s in tf.nest.flatten(cf.structured_input_signature)
+        if isinstance(s, tf.TensorSpec)
+    ]
+    in_map = {
+        (spec.name or f"input_{i}"): t.name
+        for i, (spec, t) in enumerate(zip(in_specs, frozen.inputs))
+    }
+    so = cf.structured_outputs
+    if isinstance(so, dict):
+        out_keys = sorted(so)
+    else:
+        out_keys = [f"output_{i}" for i in range(len(frozen.outputs))]
+    out_map = dict(zip(out_keys, (t.name for t in frozen.outputs)))
+
+    if signature_def_key is None and (feed_names or fetch_names):
+        input_names = [
+            tfx.validated_input(t, frozen.graph) for t in feed_names
+        ]
+        output_names = [
+            tfx.validated_output(t, frozen.graph) for t in fetch_names
+        ]
+        return TFInputGraph(gdef, None, None, input_names, output_names)
+
+    input_names = [tfx.tensor_name(v) for v in in_map.values()]
+    output_names = [tfx.tensor_name(v) for v in out_map.values()]
+    return TFInputGraph(gdef, dict(in_map), dict(out_map),
+                        input_names, output_names)
+
 
 def _signature(meta_graph_def, key: str):
     sigs = meta_graph_def.signature_def

@@ -140,14 +140,15 @@ def _referenced_functions(nodes) -> set[str]:
     return names
 
 
-def _reachable_nodes(graph_def, output_names) -> list:
+def reachable_nodes(graph_def, output_names) -> list:
     """Main-graph nodes reachable from ``output_names`` via DATA edges.
 
     Control edges (``^dep``) are deliberately not followed: the native
     translator ignores them (frozen graphs carry no state), and a dead
     Assert/Print hooked on only by control dependency is executable by the
     call_tf fallback anyway — scanning it would reject graphs both paths
-    can in fact run.
+    can in fact run. Shared with tf2jax.untranslatable_ops (single
+    reachability definition for the whole ingestion stack).
     """
     by_name = {n.name: n for n in graph_def.node}
     pending = [name.split(":")[0].lstrip("^") for name in output_names]
@@ -192,7 +193,7 @@ def scan_graph_def(
                 violations.append((where + n.name, n.op, reason))
 
     if output_names is not None:
-        main_nodes = _reachable_nodes(graph_def, output_names)
+        main_nodes = reachable_nodes(graph_def, output_names)
     else:
         main_nodes = list(graph_def.node)
     scan_nodes(main_nodes)

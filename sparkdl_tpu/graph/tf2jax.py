@@ -43,6 +43,12 @@ import contextvars
 _F32_PRECISION = contextvars.ContextVar("sparkdl_tf2jax_f32_precision",
                                         default="highest")
 
+#: function library of the graph currently being translated (name ->
+#: FunctionDef) — functional control-flow translators (If/While) convert
+#: their branch bodies through it on demand.
+_LIBRARY: "contextvars.ContextVar[dict]" = contextvars.ContextVar(
+    "sparkdl_tf2jax_library", default={})
+
 
 # --------------------------------------------------------------------------
 # attr plumbing
@@ -93,6 +99,8 @@ def _attr(node, name, default=None):
         return []
     if kind == "shape":
         return [d.size for d in a.shape.dim]
+    if kind == "func":
+        return a.func.name
     return default
 
 
@@ -608,6 +616,66 @@ def _register_simple():
         shape = _static(shape, node, "shape")
         return xp.broadcast_to(x, tuple(int(s) for s in shape))
 
+    # -- functional control flow -----------------------------------------
+    def _fdef_to_callable(fname: str, node) -> Callable:
+        """Translate a library FunctionDef into a JAX callable over its
+        args (nested call sites inside the body are inlined first)."""
+        lib = _LIBRARY.get()
+        fdef = lib.get(fname)
+        if fdef is None:
+            raise GraphTranslationError(
+                f"node {node.name!r} ({node.op}): branch function "
+                f"{fname!r} not in the graph's function library"
+            )
+        from sparkdl_tpu.graph._tf import require_tf
+
+        require_tf()
+        from tensorflow.python.framework import (
+            function_def_to_graph as _fd2g,
+        )
+        from sparkdl_tpu.graph.flatten import inline_function_calls
+
+        sub, nested = _fd2g.function_def_to_graph_def(
+            fdef, include_library_functions=True
+        )
+        in_names = [f"{a.name}:0" for a in fdef.signature.input_arg]
+        out_names = [
+            nested[fdef.ret[a.name]] for a in fdef.signature.output_arg
+        ]
+        sub, out_names = inline_function_calls(sub, out_names)
+        return translate_graph_def(
+            sub, in_names, out_names,
+            f32_precision=_F32_PRECISION.get(),
+        )
+
+    for op in ("If", "StatelessIf"):
+        @_op(op)
+        def _if(xp, node, cond, *args):
+            then_fn = _fdef_to_callable(_attr(node, "then_branch"), node)
+            else_fn = _fdef_to_callable(_attr(node, "else_branch"), node)
+            operands = tuple(jnp.asarray(a) for a in args)
+            out = jax.lax.cond(
+                jnp.reshape(jnp.asarray(cond), ()).astype(bool),
+                lambda xs: then_fn(*xs),
+                lambda xs: else_fn(*xs),
+                operands,
+            )
+            return tuple(out) if len(out) > 1 else out[0]
+
+    for op in ("While", "StatelessWhile"):
+        @_op(op)
+        def _while(xp, node, *args):
+            cond_fn = _fdef_to_callable(_attr(node, "cond"), node)
+            body_fn = _fdef_to_callable(_attr(node, "body"), node)
+            init = tuple(jnp.asarray(a) for a in args)
+
+            out = jax.lax.while_loop(
+                lambda c: jnp.reshape(cond_fn(*c)[0], ()).astype(bool),
+                lambda c: tuple(body_fn(*c)),
+                init,
+            )
+            return tuple(out) if len(out) > 1 else out[0]
+
     # -- image resize (the reference's in-graph decode/resize, 2.10) -----
     @_op("ResizeBilinear")
     def _resize_bilinear(xp, node, x, size):
@@ -681,40 +749,54 @@ _register_simple()
 # --------------------------------------------------------------------------
 
 
-def _reachable(graph_def, output_names) -> list:
-    """Nodes feeding ``output_names`` via data edges (control edges are
-    ignored — same discipline as the translation walk)."""
-    by_name = {n.name: n for n in graph_def.node}
-    pending = [tfx.op_name(n) for n in output_names]
-    seen: set[str] = set()
-    out = []
-    while pending:
-        cur = pending.pop()
-        if cur in seen:
-            continue
-        seen.add(cur)
-        node = by_name.get(cur)
-        if node is None:
-            continue  # translate_graph_def reports missing nodes properly
-        out.append(node)
-        for inp in node.input:
-            if not inp.startswith("^"):
-                pending.append(tfx.op_name(inp))
-    return out
-
-
 def untranslatable_ops(graph_def, output_names=None) -> "list[str]":
     """Ops that the native translator does NOT cover (empty list == fully
     translatable). Const/Placeholder/NoOp are structural and always fine.
     With ``output_names``, only the output-feeding subgraph is scanned, so
-    unpruned graphs carrying dead nodes keep the native path."""
+    unpruned graphs carrying dead nodes keep the native path.
+
+    Call sites (PartitionedCall / direct function-name ops) count as
+    translatable when their target is in the library — flatten.py inlines
+    them before translation — and the scan recurses into every referenced
+    function body (If/While branches, call targets) so a host-side op
+    hiding inside a tf.function still surfaces here."""
     structural = {"Const", "Placeholder", "NoOp"}
+    call_ops = {"PartitionedCall", "StatefulPartitionedCall"}
+    lib = {f.signature.name: f for f in graph_def.library.function}
+    missing: set[str] = set()
+    seen_fns: set[str] = set()
+
+    def scan(nodes):
+        pending_fns = []
+        for n in nodes:
+            op = n.op
+            if op in call_ops or op in lib:
+                tgt = op if op in lib else n.attr["f"].func.name
+                if tgt in lib:
+                    pending_fns.append(tgt)
+                else:
+                    missing.add(op)
+                continue
+            if op not in structural and op not in _TRANSLATORS:
+                missing.add(op)
+            # If/While branches (and any other func-valued attr)
+            for a in n.attr.values():
+                if a.func.name:
+                    pending_fns.append(a.func.name)
+                for f in a.list.func:
+                    if f.name:
+                        pending_fns.append(f.name)
+        for fname in pending_fns:
+            if fname in lib and fname not in seen_fns:
+                seen_fns.add(fname)
+                scan(lib[fname].node_def)
+
+    from sparkdl_tpu.graph.op_surface import reachable_nodes
+
     nodes = (graph_def.node if output_names is None
-             else _reachable(graph_def, output_names))
-    return sorted({
-        n.op for n in nodes
-        if n.op not in structural and n.op not in _TRANSLATORS
-    })
+             else reachable_nodes(graph_def, output_names))
+    scan(nodes)
+    return sorted(missing)
 
 
 def translate_graph_def(
@@ -738,6 +820,24 @@ def translate_graph_def(
             f"f32_precision must be 'highest' or 'default', "
             f"got {f32_precision!r}"
         )
+
+    # TF2 function-call sites (PartitionedCall & friends) are flattened
+    # here, so every caller gets the same contract: hand in any frozen
+    # GraphDef, get a callable or a GraphTranslationError.
+    from sparkdl_tpu.graph.flatten import (
+        has_function_calls,
+        inline_function_calls,
+    )
+
+    if has_function_calls(graph_def):
+        try:
+            graph_def, output_names = inline_function_calls(
+                graph_def, output_names
+            )
+        except Exception as e:
+            raise GraphTranslationError(
+                f"function-library inlining failed: {e}"
+            ) from e
 
     nodes = {n.name: n for n in graph_def.node}
     missing = untranslatable_ops(graph_def, output_names=output_names)
@@ -780,12 +880,15 @@ def translate_graph_def(
         visit(name)
 
     consts: dict[str, np.ndarray] = {}
+    library = {f.signature.name: f for f in graph_def.library.function}
 
     def fn(*arrays) -> tuple:
         token = _F32_PRECISION.set(f32_precision)
+        lib_token = _LIBRARY.set(library)
         try:
             return _run(*arrays)
         finally:
+            _LIBRARY.reset(lib_token)
             _F32_PRECISION.reset(token)
 
     def _run(*arrays) -> tuple:

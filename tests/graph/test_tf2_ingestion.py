@@ -1,0 +1,293 @@
+"""TF2 function-call-graph ingestion (VERDICT r2 missing #1 / next #3).
+
+The reference ran ANY TF graph in a real TF session (SURVEY.md 2.7, §7
+hard part 1); a modern Keras/TF2 SavedModel freezes into a graph of
+PartitionedCall sites over a function library. These tests prove such
+graphs ingest NATIVELY — the call_tf fallback is poisoned so any use of
+it fails the test — via (a) the TF2 loader+freeze path for SavedModels,
+(b) flatten.py inlining for GraphDefs that still carry call sites, and
+(c) lax.cond / lax.while_loop translation of functional If/While.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax
+
+from sparkdl_tpu.graph.builder import GraphFunction
+from sparkdl_tpu.graph.flatten import (
+    has_function_calls,
+    inline_function_calls,
+)
+from sparkdl_tpu.graph.input import TFInputGraph
+from sparkdl_tpu.graph.tf2jax import untranslatable_ops
+
+rng = np.random.default_rng(7)
+
+
+@pytest.fixture
+def no_call_tf(monkeypatch):
+    """Poison the call_tf fallback: native-path-or-fail."""
+    from jax.experimental import jax2tf
+
+    def poisoned(*a, **k):
+        raise AssertionError("call_tf fallback used — native path required")
+
+    monkeypatch.setattr(jax2tf, "call_tf", poisoned)
+
+
+_EXPORT_SCRIPT = """
+import sys, numpy as np
+import tensorflow as tf
+
+d = sys.argv[1]
+rng = np.random.default_rng(7)
+inp = tf.keras.Input([4])
+h = tf.keras.layers.Dense(8, activation="relu")(inp)
+h = tf.keras.layers.BatchNormalization()(h)
+out = tf.keras.layers.Dense(3, activation="softmax")(h)
+m = tf.keras.Model(inp, out)
+
+@tf.function(input_signature=[tf.TensorSpec([None, 4], tf.float32)])
+def serve(x):
+    return {"probs": m(x, training=False)}
+
+tf.saved_model.save(m, d, signatures={"serving_default": serve})
+x = rng.standard_normal((6, 4)).astype(np.float32)
+np.savez(d + "/oracle.npz", x=x, y=m(x, training=False).numpy())
+"""
+
+
+@pytest.fixture(scope="module")
+def keras_savedmodel(tmp_path_factory):
+    """A genuinely Keras-exported TF2 SavedModel + oracle outputs.
+
+    Exported in a clean subprocess: sparkdl_tpu defaults KERAS_BACKEND to
+    jax in this process, under which Keras models are not TF Trackables —
+    exactly the situation of a user who exported the model elsewhere and
+    hands the artifact to the pipeline.
+    """
+    import os
+    import subprocess
+    import sys
+
+    d = str(tmp_path_factory.mktemp("tf2sm") / "sm")
+    env = dict(os.environ, KERAS_BACKEND="tensorflow",
+               TF_CPP_MIN_LOG_LEVEL="2")
+    subprocess.run(
+        [sys.executable, "-c", _EXPORT_SCRIPT, d],
+        check=True, env=env, capture_output=True, text=True,
+    )
+    data = np.load(d + "/oracle.npz")
+    return d, data["x"], data["y"]
+
+
+def test_keras_tf2_savedmodel_ingests_natively(keras_savedmodel, no_call_tf):
+    d, x, want = keras_savedmodel
+
+    # precondition: the saved artifact IS a function-call graph
+    from tensorflow.python.saved_model import loader_impl
+
+    mg = loader_impl.parse_saved_model(d).meta_graphs[0]
+    ops = {n.op for n in mg.graph_def.node}
+    assert "StatefulPartitionedCall" in ops
+    assert len(mg.graph_def.library.function) > 0
+
+    tig = TFInputGraph.fromSavedModelWithSignature(d)
+    assert untranslatable_ops(tig.graph_def, tig.output_names) == []
+
+    fn = tig.to_jax()
+    got = np.asarray(jax.jit(lambda a: fn(a)[0])(x))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+    # signature translation maps keys to frozen tensor names
+    om = tig.translateOutputMapping({"probs": "out_col"})
+    assert list(om.values()) == ["out_col"]
+
+
+def test_tf2_savedmodel_explicit_fetch_names(keras_savedmodel, no_call_tf):
+    d, x, want = keras_savedmodel
+    sig = TFInputGraph.fromSavedModelWithSignature(d)
+    in_name = sig.input_names[0]
+    out_name = sig.output_names[0]
+    tig = TFInputGraph.fromSavedModel(
+        d, feed_names=[in_name], fetch_names=[out_name]
+    )
+    got = np.asarray(tig.to_jax()(x)[0])
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+
+def _concrete_graphdef(fn, *specs):
+    cf = fn.get_concrete_function(*specs)
+    gd = cf.graph.as_graph_def(add_shapes=True)
+    ins = [t.name for t in cf.inputs]
+    outs = [t.name for t in cf.outputs]
+    return cf, gd, ins, outs
+
+
+def test_inline_nested_partitioned_calls(no_call_tf):
+    """Two-level tf.function nesting with a multi-output inner fn and a
+    passthrough return — the flatten fixpoint must resolve chains."""
+
+    @tf.function
+    def inner(x):
+        return tf.nn.relu(x) * 2.0, x  # second output is a passthrough
+
+    @tf.function
+    def mid(x):
+        a, b = inner(x)
+        return a + b
+
+    @tf.function
+    def outer(x):
+        return mid(x) - 1.0
+
+    cf, gd, ins, outs = _concrete_graphdef(
+        outer, tf.TensorSpec([None, 3], tf.float32)
+    )
+    assert has_function_calls(gd)
+    flat, flat_outs = inline_function_calls(gd, outs)
+    assert not has_function_calls(flat)
+    assert untranslatable_ops(flat, flat_outs) == []
+
+    x = rng.standard_normal((4, 3)).astype(np.float32)
+    jfn = GraphFunction(gd, ins, outs).to_jax()
+    got = np.asarray(jax.jit(lambda a: jfn(a)[0])(x))
+    want = cf(tf.constant(x)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_functional_if_translates_to_lax_cond(no_call_tf):
+    @tf.function
+    def f(pred, x):
+        return tf.cond(pred, lambda: x * 2.0 + 1.0, lambda: -x)
+
+    cf, gd, ins, outs = _concrete_graphdef(
+        f, tf.TensorSpec([], tf.bool), tf.TensorSpec([None, 3], tf.float32)
+    )
+    node_ops = {n.op for n in gd.node} | {
+        n.op for fn_ in gd.library.function for n in fn_.node_def
+    }
+    assert node_ops & {"If", "StatelessIf"}, node_ops
+
+    x = rng.standard_normal((2, 3)).astype(np.float32)
+    jfn = GraphFunction(gd, ins, outs).to_jax()
+    for pred in (True, False):
+        got = np.asarray(jax.jit(lambda p, a: jfn(p, a)[0])(pred, x))
+        want = cf(tf.constant(pred), tf.constant(x)).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_functional_while_translates_to_lax_while(no_call_tf):
+    @tf.function
+    def f(x):
+        i = tf.constant(0)
+        def cond(i, acc):
+            return i < 5
+        def body(i, acc):
+            return i + 1, acc + tf.cast(i, tf.float32)
+        _, out = tf.while_loop(cond, body, [i, x])
+        return out
+
+    cf, gd, ins, outs = _concrete_graphdef(
+        f, tf.TensorSpec([2, 2], tf.float32)
+    )
+    node_ops = {n.op for n in gd.node} | {
+        n.op for fn_ in gd.library.function for n in fn_.node_def
+    }
+    assert node_ops & {"While", "StatelessWhile"}, node_ops
+
+    x = rng.standard_normal((2, 2)).astype(np.float32)
+    jfn = GraphFunction(gd, ins, outs).to_jax()
+    got = np.asarray(jax.jit(lambda a: jfn(a)[0])(x))
+    want = cf(tf.constant(x)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_duplicate_data_edges_survive_inlining(no_call_tf):
+    """AddN(y, y) where y is a call output: the rewiring pass must keep
+    BOTH data edges (dedup applies to control edges only) — dropping one
+    silently halves the result."""
+
+    @tf.function
+    def inner(x):
+        return x * 2.0 + 1.0
+
+    @tf.function
+    def outer(x):
+        y = inner(x)
+        return tf.add_n([y, y, y]) * tf.raw_ops.Mul(x=y, y=y)
+
+    cf, gd, ins, outs = _concrete_graphdef(
+        outer, tf.TensorSpec([2, 2], tf.float32)
+    )
+    x = rng.standard_normal((2, 2)).astype(np.float32)
+    jfn = GraphFunction(gd, ins, outs).to_jax()
+    got = np.asarray(jfn(x)[0])
+    np.testing.assert_allclose(got, cf(tf.constant(x)).numpy(), atol=1e-5)
+
+
+def test_translate_graph_def_handles_call_sites_directly(no_call_tf):
+    """Public-contract check: translate_graph_def on a raw call-site graph
+    inlines internally (no KeyError, no pre-flatten required)."""
+    from sparkdl_tpu.graph.tf2jax import translate_graph_def
+
+    @tf.function
+    def inner(x):
+        return tf.tanh(x)
+
+    @tf.function
+    def outer(x):
+        return inner(x) + 0.5
+
+    cf, gd, ins, outs = _concrete_graphdef(
+        outer, tf.TensorSpec([3], tf.float32)
+    )
+    fn = translate_graph_def(gd, ins, outs)
+    x = rng.standard_normal(3).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(fn(x)[0]), cf(tf.constant(x)).numpy(), atol=1e-6)
+
+
+def test_host_op_inside_function_body_still_surfaces():
+    """untranslatable_ops recurses into function bodies: an uncovered op
+    hiding behind a PartitionedCall is reported, not silently accepted."""
+
+    @tf.function
+    def inner(x):
+        return tf.linalg.inv(x)  # MatrixInverse: outside the surface
+
+    @tf.function
+    def outer(x):
+        return inner(x) + 1.0
+
+    _, gd, ins, outs = _concrete_graphdef(
+        outer, tf.TensorSpec([3, 3], tf.float32)
+    )
+    assert "MatrixInverse" in untranslatable_ops(gd, outs)
+
+
+def test_tf2_transformer_end_to_end(keras_savedmodel, no_call_tf):
+    """API-level closure: TFTransformer over a DataFrame with a TF2
+    SavedModel input graph matches the Keras forward."""
+    d, x, want = keras_savedmodel
+    tig = TFInputGraph.fromSavedModelWithSignature(d)
+
+    from sparkdl_tpu.dataframe import LocalDataFrame
+    from sparkdl_tpu.transformers.tf_tensor import TFTransformer
+
+    df = LocalDataFrame.from_rows(
+        [{"v": x[i].tolist()} for i in range(len(x))], 2
+    )
+    tft = TFTransformer(
+        tfInputGraph=tig,
+        inputMapping={"v": "x"},
+        outputMapping={"probs": "probs"},
+    )
+    rows = tft.transform(df).collect()
+    got = np.asarray([r["probs"] for r in rows])
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
