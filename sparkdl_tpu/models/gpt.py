@@ -54,9 +54,10 @@ class GPTConfig:
     rope_base: float = 10000.0
     layer_norm_eps: float = 1e-5
     dropout: float = 0.0
-    #: "full" | "flash" (Pallas fused kernel) | "ring" (sp-sharded).
-    #: Applies to the UNCACHED forward only: KV-cached decode always takes
-    #: the dense masked path over the cache buffer regardless of this knob.
+    #: "full" | "flash" (Pallas fused kernels) | "ring" (sp-sharded).
+    #: "flash" covers BOTH the uncached forward (ops/flash_attention) and
+    #: single-token KV-cached decode (ops/flash_decode); cached PREFILL
+    #: (L>1 with cache) still takes the dense masked path.
     attn_impl: str = "full"
     sp_axis: str = "sp"
     #: 0 = dense MLPs; >0 = MoE with this many experts
@@ -151,17 +152,25 @@ class GPTAttention(nn.Module):
                 (0, idx, 0, 0),
             )
             new_entry = (ck, cv)
-            max_len = ck.shape[1]
-            q_pos = idx + jnp.arange(l)  # [L]
-            k_pos = jnp.arange(max_len)  # [max_len]
-            mask = k_pos[None, :] <= q_pos[:, None]  # causal + not-yet-written
-            s = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, ck,
-                preferred_element_type=jnp.float32,
-            ) / math.sqrt(hd)
-            s = jnp.where(mask[None, None], s, _NEG_INF)
-            p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
-            ctx = jnp.einsum("bhqk,bkhd->bqhd", p, cv)
+            if c.attn_impl == "flash" and l == 1:
+                # the serving hot loop: single-query flash decode streams
+                # the cache once, no [B,H,1,L] scores in HBM
+                from sparkdl_tpu.ops.flash_decode import flash_decode
+
+                ctx = flash_decode(q, ck, cv, idx)
+            else:
+                # prefill (L>1) and non-flash decode: dense masked path
+                max_len = ck.shape[1]
+                q_pos = idx + jnp.arange(l)  # [L]
+                k_pos = jnp.arange(max_len)  # [max_len]
+                mask = k_pos[None, :] <= q_pos[:, None]  # causal+unwritten
+                s = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q, ck,
+                    preferred_element_type=jnp.float32,
+                ) / math.sqrt(hd)
+                s = jnp.where(mask[None, None], s, _NEG_INF)
+                p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
+                ctx = jnp.einsum("bhqk,bkhd->bqhd", p, cv)
         else:
             new_entry = None
             if c.attn_impl == "flash":

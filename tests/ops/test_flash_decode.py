@@ -1,0 +1,60 @@
+"""flash_decode oracle: the single-query cached-attention kernel must
+match the dense masked path bit-closely at every cache fill level."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.ops.flash_decode import flash_decode, reference_decode
+
+rng = np.random.default_rng(17)
+
+
+def _mk(b, lmax, h, d, dtype=np.float32):
+    q = rng.standard_normal((b, 1, h, d)).astype(dtype)
+    ck = rng.standard_normal((b, lmax, h, d)).astype(dtype)
+    cv = rng.standard_normal((b, lmax, h, d)).astype(dtype)
+    return q, ck, cv
+
+
+@pytest.mark.parametrize("idx", [0, 1, 63, 100, 255])
+def test_matches_dense_at_fill_levels(idx):
+    q, ck, cv = _mk(2, 256, 3, 64)
+    got = flash_decode(q, ck, cv, idx, block_k=64)
+    want = reference_decode(q, ck, cv, idx)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ragged_block_and_traced_idx():
+    q, ck, cv = _mk(1, 96, 2, 32)  # 96 not divisible by 64 -> gcd block
+
+    @jax.jit
+    def run(q, ck, cv, idx):
+        return flash_decode(q, ck, cv, idx, block_k=64)
+
+    for idx in (0, 42, 95):
+        got = run(q, ck, cv, jnp.asarray(idx, jnp.int32))
+        want = reference_decode(q, ck, cv, idx)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_bf16_tolerance():
+    q, ck, cv = _mk(2, 128, 2, 64)
+    qb, kb, vb = (jnp.bfloat16(t) for t in (q, ck, cv))
+    got = flash_decode(qb, kb, vb, 100)
+    want = reference_decode(qb, kb, vb, 100)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=2e-2, rtol=2e-2)
+
+
+def test_rejects_multi_query():
+    q = jnp.zeros((1, 2, 2, 16))
+    with pytest.raises(ValueError, match="single-query"):
+        flash_decode(q, jnp.zeros((1, 8, 2, 16)), jnp.zeros((1, 8, 2, 16)), 0)
