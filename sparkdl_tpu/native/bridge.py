@@ -201,18 +201,24 @@ class DeviceFeeder:
     the host is packing batch i+2 while batch i+1 is on the wire and batch
     i is computing: the double-buffered infeed.
 
-    ``batches``: yields np.ndarray (single-tensor feed) of identical dtype;
-    shapes may vary in the leading dim only. ``transfer`` defaults to
-    jax.device_put (pass a sharded device_put for multi-chip feeds).
+    ``batches``: yields either np.ndarray (single-tensor feed) or
+    dict[str, np.ndarray] with a FIXED key set (struct-of-tensors feed —
+    text's input_ids+attention_mask and multi-input ingested graphs). A
+    dict batch occupies one slot with a fixed byte segment per key, so
+    the whole struct rides one ring transaction; the iterator then yields
+    dicts of device arrays. Shapes may vary in the leading dim only.
+    ``transfer`` defaults to jax.device_put (pass a sharded device_put
+    for multi-chip feeds). ``max_batch_bytes`` bounds slot segment sizes:
+    an int for array feeds, a per-key dict for struct feeds.
     """
 
     def __init__(
         self,
-        batches: Iterable[np.ndarray],
+        batches: "Iterable[np.ndarray | dict[str, np.ndarray]]",
         *,
         n_slots: int = 3,
         transfer: Callable[[np.ndarray], Any] | None = None,
-        max_batch_bytes: int | None = None,
+        max_batch_bytes: "int | dict[str, int] | None" = None,
     ):
         self._batches = batches
         self._n_slots = n_slots
@@ -228,16 +234,57 @@ class DeviceFeeder:
             first = next(it)
         except StopIteration:
             return
-        first = np.ascontiguousarray(first)
-        slot_bytes = self._max_bytes or first.nbytes
+
+        # normalize both feed forms onto the struct layout: an array feed
+        # is a one-key struct that unwraps on yield
+        is_struct = isinstance(first, dict)
+        if self._max_bytes is not None and is_struct != isinstance(
+                self._max_bytes, dict):
+            raise TypeError(
+                "max_batch_bytes must match the feed form: a dict of "
+                "per-key byte caps for dict feeds, an int for array "
+                f"feeds (got {type(self._max_bytes).__name__} for a "
+                f"{'dict' if is_struct else 'array'} feed)"
+            )
+        if is_struct:
+            keys = list(first)
+            first = {k: np.ascontiguousarray(first[k]) for k in keys}
+            seg = dict(self._max_bytes or {})
+            for k in keys:
+                seg.setdefault(k, first[k].nbytes)
+        else:
+            keys = ["__array__"]
+            first = {"__array__": np.ascontiguousarray(first)}
+            seg = {"__array__": (self._max_bytes
+                                 if self._max_bytes is not None
+                                 else first["__array__"].nbytes)}
+        offsets = {}
+        off = 0
+        for k in keys:
+            offsets[k] = off
+            off += seg[k]
+        slot_bytes = off
+
+        def as_struct(b):
+            if is_struct:
+                missing = [k for k in keys if k not in b]
+                if missing:
+                    raise ValueError(f"feed batch missing key(s) {missing}")
+                return {k: np.ascontiguousarray(b[k]) for k in keys}
+            return {"__array__": np.ascontiguousarray(b)}
+
+        def unwrap(d):
+            return d if is_struct else d["__array__"]
+
         if not native_available():
             FEED_STATS["fallback_streams"] += 1
             # Pure-Python path: same overlap via the prefetch queue.
             from sparkdl_tpu.runtime.prefetch import prefetch_to_device
 
             def chain():
-                yield first
-                yield from it
+                yield unwrap(first)
+                for b in it:
+                    yield b
 
             # size must stay >=1: Queue(maxsize=0) is UNbounded, the
             # opposite of the tight buffering n_slots=1 asks for.
@@ -248,7 +295,7 @@ class DeviceFeeder:
 
         ring = StagingRing(slot_bytes, self._n_slots)
         FEED_STATS["ring_streams"] += 1
-        meta: dict[int, tuple] = {}  # slot idx -> (shape, dtype)
+        meta: dict[int, dict] = {}  # slot idx -> {key: (shape, dtype)}
         out_q: queue.Queue = queue.Queue(maxsize=self._n_slots)
         stop = threading.Event()
         errors: list[BaseException] = []
@@ -256,24 +303,36 @@ class DeviceFeeder:
 
         def packer():
             try:
-                for batch in self._chain(first, it):
-                    batch = np.ascontiguousarray(batch)
-                    if batch.nbytes > slot_bytes:
-                        raise ValueError(
-                            f"batch of {batch.nbytes}B exceeds slot size "
-                            f"{slot_bytes}B (set max_batch_bytes)"
-                        )
+                for raw in self._chain(first, it):
+                    batch = as_struct(raw) if raw is not first else first
+                    total = 0
+                    for k in keys:
+                        if batch[k].nbytes > seg[k]:
+                            raise ValueError(
+                                f"feed {k!r} of {batch[k].nbytes}B exceeds "
+                                f"its slot segment {seg[k]}B (set "
+                                "max_batch_bytes)"
+                            )
+                        total += batch[k].nbytes
                     idx = None
                     while idx is None and not stop.is_set():
                         idx = ring.acquire_write(timeout_s=0.1)
                     if idx is None:
                         return
                     view = ring.slot_view(idx)
-                    view[: batch.nbytes] = batch.view(np.uint8).reshape(-1)
-                    meta[idx] = (batch.shape, batch.dtype)
-                    ring.commit_write(idx, batch.shape[0], batch.nbytes)
+                    for k in keys:
+                        o = offsets[k]
+                        view[o:o + batch[k].nbytes] = (
+                            batch[k].view(np.uint8).reshape(-1))
+                    meta[idx] = {
+                        k: (batch[k].shape, batch[k].dtype) for k in keys
+                    }
+                    ring.commit_write(
+                        idx, batch[keys[0]].shape[0],
+                        offsets[keys[-1]] + batch[keys[-1]].nbytes,
+                    )
                     FEED_STATS["ring_batches"] += 1
-                    FEED_STATS["ring_bytes"] += batch.nbytes
+                    FEED_STATS["ring_bytes"] += total
             except BaseException as e:
                 errors.append(e)
             finally:
@@ -293,12 +352,18 @@ class DeviceFeeder:
                         if ring.closed:
                             break
                         continue
-                    shape, dtype = meta.pop(idx)
-                    used = ring.slot_used(idx)
-                    host = ring.slot_view(idx)[:used].view(dtype).reshape(shape)
+                    m = meta.pop(idx)
+                    view = ring.slot_view(idx)
+                    host = {}
+                    for k in keys:
+                        shape, dtype = m[k]
+                        nbytes = int(np.prod(shape)) * dtype.itemsize
+                        o = offsets[k]
+                        host[k] = view[o:o + nbytes].view(dtype).reshape(shape)
                     if needs_copy:
-                        host = np.array(host, copy=True)
-                    arr = transfer(host)
+                        host = {k: np.array(v, copy=True)
+                                for k, v in host.items()}
+                    arr = transfer(unwrap(host))
                     # The slot must stay stable until the device copy is
                     # done; block on THIS thread (the consumer keeps
                     # computing meanwhile), then recycle the slot.

@@ -27,15 +27,16 @@ class BatchedRunner:
     apply_fn must be shape-polymorphic only across the bucket set (it is
     jitted; one compile per bucket). Outputs follow the batch leading dim.
 
-    Host->device staging: uniform-row single-tensor feeds (the image
-    featurization paths) ride the native C++ staging ring
-    (:class:`~sparkdl_tpu.native.bridge.DeviceFeeder`): packer thread ->
-    stable slot -> transfer thread -> device, double-buffered so the chip
-    computes batch i while batch i+1 is on the wire and i+2 is packing —
-    the TensorFrames-block-feed equivalent (SURVEY.md 2.15) on the actual
-    hot path. Multi-tensor feeds (e.g. text's input_ids+attention_mask),
-    ragged feeds, and hosts without the .so use the pure-Python
-    prefetcher with the same overlap semantics.
+    Host->device staging: every uniform-row feed rides the native C++
+    staging ring (:class:`~sparkdl_tpu.native.bridge.DeviceFeeder`):
+    packer thread -> stable slot -> transfer thread -> device,
+    double-buffered so the chip computes batch i while batch i+1 is on
+    the wire and i+2 is packing — the TensorFrames-block-feed equivalent
+    (SURVEY.md 2.15) on the actual hot path. Multi-tensor feeds (text's
+    input_ids+attention_mask, multi-input graphs) pack as a
+    struct-of-tensors slot with a fixed byte segment per key. Ragged
+    feeds and hosts without the .so use the pure-Python prefetcher with
+    the same overlap semantics.
 
     ``ragged_rows=True`` declares that row shapes vary across batches
     (e.g. un-resized images into a dynamic-spatial graph): ring slots are
@@ -94,19 +95,21 @@ class BatchedRunner:
             yield first
             yield from it
 
-        if native_available() and len(keys) == 1 and not self.ragged_rows:
-            (key,) = keys
-            v0 = first[key]
-            # slots sized for the LARGEST bucket; the first batch may be a
-            # smaller tail bucket
-            row_bytes = v0.nbytes // max(v0.shape[0], 1)
-            feeder = DeviceFeeder(
-                (b[key] for b in chained()),
-                n_slots=self.prefetch + 1,
-                max_batch_bytes=row_bytes * self.batch_size,
+        if native_available() and not self.ragged_rows:
+            # struct-of-tensors slots: EVERY uniform feed rides the ring —
+            # single-tensor image batches and multi-tensor text batches
+            # (input_ids+attention_mask) alike (SURVEY.md 2.15 parity:
+            # the reference's bridge moved all blocks natively). Segments
+            # are sized for the LARGEST bucket; the first batch may be a
+            # smaller tail bucket.
+            seg = {
+                k: (first[k].nbytes // max(first[k].shape[0], 1))
+                * self.batch_size
+                for k in keys
+            }
+            yield from DeviceFeeder(
+                chained(), n_slots=self.prefetch + 1, max_batch_bytes=seg,
             )
-            for arr in feeder:
-                yield {key: arr}
             return
         yield from prefetch_to_device(
             chained(), size=self.prefetch, transfer=self._transfer

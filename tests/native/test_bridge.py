@@ -126,7 +126,7 @@ def test_device_feeder_ragged_leading_dim():
 
 def test_device_feeder_oversized_batch_raises():
     batches = [np.ones((2, 2), np.float32), np.ones((64, 64), np.float32)]
-    with pytest.raises(ValueError, match="exceeds slot size"):
+    with pytest.raises(ValueError, match="exceeds its slot segment"):
         list(DeviceFeeder(iter(batches)))
 
 

@@ -37,19 +37,72 @@ def test_batched_runner_single_tensor_feed_rides_the_ring(feed_stats):
     assert bridge.FEED_STATS["ring_bytes"] > feed_stats["ring_bytes"]
 
 
-def test_multi_tensor_feed_uses_python_fallback(feed_stats):
+def test_multi_tensor_feed_rides_the_ring(feed_stats):
+    """VERDICT r2 next #4: struct-of-tensors slots — a dict feed (the
+    text-featurization shape) traverses the native ring, one slot per
+    batch with a fixed byte segment per key."""
+    if not bridge.native_available():
+        pytest.skip("native bridge not built on this host")
     import jax.numpy as jnp
 
     runner = BatchedRunner(
-        lambda b: b["a"].astype(jnp.float32) + b["b"].astype(jnp.float32),
+        lambda b: b["a"].astype(jnp.float32) * 2
+        + b["b"].astype(jnp.float32),
         batch_size=4,
     )
-    rows = ({"a": np.ones(3, np.float32), "b": np.ones(3, np.float32)}
-            for _ in range(6))
+    rows = ({"a": np.full(3, i, np.float32), "b": np.full(3, i, np.int32)}
+            for i in range(10))
+    out = list(runner.run(rows))
+    assert len(out) == 10
+    np.testing.assert_allclose(out[7], np.full(3, 21.0))
+    assert bridge.FEED_STATS["ring_streams"] == feed_stats["ring_streams"] + 1
+    assert bridge.FEED_STATS["ring_batches"] >= feed_stats["ring_batches"] + 3
+
+
+def test_ragged_feed_uses_python_fallback(feed_stats):
+    import jax.numpy as jnp
+
+    runner = BatchedRunner(
+        lambda b: b["a"].astype(jnp.float32), batch_size=4,
+        ragged_rows=True,
+    )
+    rows = ({"a": np.ones(3, np.float32)} for _ in range(6))
     out = list(runner.run(rows))
     assert len(out) == 6
-    # dict feeds can't ride the single-tensor ring: stream count unchanged
+    # ragged feeds must keep to the Python path: stream count unchanged
     assert bridge.FEED_STATS["ring_streams"] == feed_stats["ring_streams"]
+
+
+def test_text_featurizer_traverses_ring(feed_stats):
+    """End-to-end: DeepTextFeaturizer.transform (input_ids+attention_mask
+    struct feed) -> BatchedRunner -> DeviceFeeder -> StagingRing."""
+    if not bridge.native_available():
+        pytest.skip("native bridge not built on this host")
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.dataframe.local import LocalDataFrame
+    from sparkdl_tpu.models.bert import BertConfig, BertModel
+    from sparkdl_tpu.transformers.text import DeepTextFeaturizer
+
+    cfg = BertConfig.tiny(vocab_size=64)
+    variables = BertModel(cfg).init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32),
+    )
+    rng = np.random.default_rng(0)
+    rows = [
+        {"tokens": rng.integers(1, 64, rng.integers(3, 12)).astype(int)}
+        for _ in range(9)
+    ]
+    df = LocalDataFrame([rows])
+    ft = DeepTextFeaturizer(
+        inputCol="tokens", outputCol="features", model=(cfg, variables),
+        maxLength=16, batchSize=4,
+    )
+    got = ft.transform(df).collect()
+    assert len(got) == 9 and got[0]["features"] is not None
+    assert bridge.FEED_STATS["ring_streams"] > feed_stats["ring_streams"]
 
 
 def test_named_image_transform_traverses_ring(feed_stats):
