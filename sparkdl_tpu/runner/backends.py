@@ -71,7 +71,11 @@ class LocalProcessBackend:
         import cloudpickle
 
         env_overrides = {}
-        if self.platform == "cpu" and self.devices_per_process > 1:
+        if self.platform == "cpu":
+            # always pin the child's device count — devices_per_process=1
+            # must MEAN one device even when the parent env carries a
+            # --xla_force_host_platform_device_count (the test harness
+            # does), else children silently inherit the parent's topology
             env_overrides = virtual_cpu_overrides(
                 self.devices_per_process, os.environ.get("XLA_FLAGS", "")
             )

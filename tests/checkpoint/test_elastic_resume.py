@@ -1,0 +1,117 @@
+"""Elastic-topology preemption resume (VERDICT r2 next #6).
+
+A preempted pod frequently comes back a different size. These jobs train
+with a dp-SHARDED train state on one topology, SIGKILL every rank
+mid-run, and resume on a DIFFERENT device count — both growing (4→8
+devices) and shrinking (4→2). The template-sharded restore must reshard
+the checkpoint onto the new mesh, and the resumed loss trajectory must
+match an uninterrupted run (same per-step seeds; replicated batches make
+the math topology-invariant up to f32 reduction order)."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from sparkdl_tpu.runner import TPURunner
+
+
+def _train_job(ckpt_dir, total_steps, die_at_step=None):
+    """Per-rank body: dp-sharded state, checkpoint every step."""
+    import functools
+    import os
+    import signal
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkdl_tpu.checkpoint import CheckpointManager
+
+    mesh = jax.make_mesh((jax.device_count(),), ("dp",))
+    sharded = NamedSharding(mesh, P("dp"))  # state genuinely distributed
+    repl = NamedSharding(mesh, P())
+
+    @functools.partial(jax.jit,
+                       out_shardings={"w": sharded, "step": repl})
+    def init_state():
+        return {"w": jnp.zeros((16, 4), jnp.float32),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def loss_fn(w, x):
+        return jnp.mean((x @ w - 1.0) ** 2)
+
+    @jax.jit
+    def train_step(state, step):
+        x = jax.random.normal(jax.random.PRNGKey(step), (8, 16))
+        loss, g = jax.value_and_grad(loss_fn)(state["w"], x)
+        return {"w": state["w"] - 0.1 * g,
+                "step": jnp.asarray(step, jnp.int32)}, loss
+
+    state = init_state()
+    mgr = CheckpointManager(ckpt_dir, keep=2)
+    start = 0
+    if mgr.latest_step() is not None:
+        # template carries THIS topology's shardings: the restore reshards
+        # the (possibly differently-sharded) checkpoint onto this mesh
+        state = mgr.restore(template=state)
+        start = int(state["step"]) + 1
+
+    losses = []
+    for step in range(start, total_steps):
+        state, loss = train_step(state, step)
+        losses.append(float(loss))
+        mgr.save(step, state)
+        mgr.wait()
+        if die_at_step is not None and start == 0 and step == die_at_step:
+            multihost_utils.sync_global_devices("about to die")
+            os.kill(os.getpid(), signal.SIGKILL)
+    mgr.close()
+    return {
+        "resumed_from": start,
+        "losses": losses,
+        "ndev": jax.device_count(),
+    }
+
+
+def _kill_then_resume(tmp_path, name, resume_np, resume_dpp, total=6):
+    ckpt = os.fspath(tmp_path / name)
+    # attempt 1: 2 procs x 2 devices = 4-device dp mesh, killed after
+    # step 2's checkpoint is durable
+    with pytest.raises(RuntimeError, match="rank"):
+        TPURunner(np=-2, devices_per_process=2, timeout_s=300).run(
+            _train_job, ckpt_dir=ckpt, total_steps=total, die_at_step=2
+        )
+    # attempt 2: DIFFERENT topology
+    out = TPURunner(np=resume_np, devices_per_process=resume_dpp,
+                    timeout_s=300).run(
+        _train_job, ckpt_dir=ckpt, total_steps=total
+    )
+    assert out["resumed_from"] == 3
+    assert len(out["losses"]) == 3
+    return out
+
+
+@pytest.mark.slow
+def test_resume_on_more_devices_matches_uninterrupted(tmp_path):
+    out = _kill_then_resume(tmp_path, "grow", resume_np=-4, resume_dpp=2)
+    assert out["ndev"] == 8
+
+    ref = TPURunner(np=-2, devices_per_process=2, timeout_s=300).run(
+        _train_job, ckpt_dir=os.fspath(tmp_path / "ref"), total_steps=6
+    )
+    assert ref["resumed_from"] == 0
+    assert out["losses"] == pytest.approx(ref["losses"][3:], rel=1e-5)
+
+
+@pytest.mark.slow
+def test_resume_on_fewer_devices_matches_uninterrupted(tmp_path):
+    out = _kill_then_resume(tmp_path, "shrink", resume_np=-2, resume_dpp=1)
+    assert out["ndev"] == 2
+
+    ref = TPURunner(np=-2, devices_per_process=2, timeout_s=300).run(
+        _train_job, ckpt_dir=os.fspath(tmp_path / "ref2"), total_steps=6
+    )
+    assert out["losses"] == pytest.approx(ref["losses"][3:], rel=1e-5)
