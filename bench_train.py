@@ -24,13 +24,21 @@ def main() -> None:
 
     from sparkdl_tpu.models.resnet import ResNet50
     from sparkdl_tpu.observability.metrics import StepMeter, compiled_flops
-    from sparkdl_tpu.train.vision import make_vision_train_step
+    from sparkdl_tpu.train.vision import (
+        make_resnet50_fused_train_step,
+        make_vision_train_step,
+    )
 
     platform = jax.default_backend()
     on_accel = platform not in ("cpu",)
     batch = int(os.environ.get("BENCH_BATCH", 256 if on_accel else 8))
     steps = int(os.environ.get("BENCH_STEPS", 10 if on_accel else 2))
     repeats = int(os.environ.get("BENCH_REPEATS", 3 if on_accel else 1))
+    # BENCH_FUSED=1 runs the Pallas BN-epilogue step; NOT the default —
+    # measured round 3, kernel islands inside the XLA conv program pay a
+    # layout-conversion tax that outweighs the fused passes (PERF.md
+    # "Round 3"). Default = the XLA lowering, the faster program today.
+    fused = os.environ.get("BENCH_FUSED", "0") == "1"
     size = 224 if on_accel else 32
     dtype = jnp.bfloat16 if on_accel else jnp.float32
 
@@ -41,14 +49,24 @@ def main() -> None:
     params, batch_stats = variables["params"], variables["batch_stats"]
     tx = optax.sgd(0.1, momentum=0.9)
     opt_state = tx.init(params)
-    train_step = make_vision_train_step(model, tx, donate=False)
+    train_step = (
+        make_resnet50_fused_train_step(
+            tx, num_classes=1000, dtype=dtype, donate=False
+        )
+        if fused else make_vision_train_step(model, tx, donate=False)
+    )
+    # FLOPs are ALWAYS counted on the unfused (pure-XLA) step:
+    # cost_analysis reports Pallas custom calls as 0 FLOPs, which would
+    # silently understate the fused path's MFU — the same semantic
+    # program must yield the same denominator either way.
+    flops_step = make_vision_train_step(model, tx, donate=False)
 
     rng = np.random.default_rng(0)
     x = jax.device_put(rng.random((batch, size, size, 3), np.float32))
     y = jax.device_put(rng.integers(0, 1000, batch).astype(np.int32))
 
     flops_per_step = compiled_flops(
-        train_step, params, batch_stats, opt_state, x, y
+        flops_step, params, batch_stats, opt_state, x, y
     )
     meter = StepMeter(flops_per_step=flops_per_step, n_chips=1)
 

@@ -116,6 +116,10 @@ class ZooModule(nn.Module):
 
 
 def max_pool(x, window: int = 3, strides: int = 2, padding: str = "VALID"):
+    # Stays on XLA's reduce_window/select_and_scatter: the gather-form
+    # backward in ops/pooling.py oracle-matches but measured ~2x slower
+    # in-program (PERF.md round 3 — the first-tap mask materializes an
+    # s32 map and the tap accumulation doesn't fuse as tightly).
     return nn.max_pool(x, (window, window), (strides, strides), padding)
 
 

@@ -1,0 +1,32 @@
+"""Shared helpers for the Pallas kernel family (flash attention/decode,
+fused GEMM+BN): scratch-space constructors and the interpret-mode default.
+One definition so a convention change (e.g. an env override for interpret
+mode) lands everywhere at once."""
+
+from __future__ import annotations
+
+import jax
+
+
+def vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)
+
+
+def smem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.SMEM(shape, dtype)
+
+
+def smem_space():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.SMEM
+
+
+def auto_interpret() -> bool:
+    """Pallas interpreter mode anywhere that is not a real TPU backend
+    (the CPU test harness and the virtual mesh)."""
+    return jax.default_backend() != "tpu"

@@ -29,16 +29,9 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
-def _vmem(shape, dtype):
-    from jax.experimental.pallas import tpu as pltpu
-
-    return pltpu.VMEM(shape, dtype)
-
-
-def _smem(shape, dtype):
-    from jax.experimental.pallas import tpu as pltpu
-
-    return pltpu.SMEM(shape, dtype)
+from sparkdl_tpu.ops._pallas import smem as _smem
+from sparkdl_tpu.ops._pallas import smem_space as _smem_space
+from sparkdl_tpu.ops._pallas import vmem as _vmem
 
 
 def _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref,
@@ -95,7 +88,9 @@ def flash_decode(q, ck, cv, idx, *, block_k: int = 512,
     ``softmax(q·K[:idx+1]ᵀ/√D)·V[:idx+1]``.
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from sparkdl_tpu.ops._pallas import auto_interpret
+
+        interpret = auto_interpret()
     b, lq, h, d = q.shape
     if lq != 1:
         raise ValueError(f"flash_decode is single-query (got L={lq})")
@@ -129,12 +124,6 @@ def flash_decode(q, ck, cv, idx, *, block_k: int = 512,
         interpret=interpret,
     )(idx_arr, qf, kf, vf)
     return out.reshape(b, h, d).reshape(b, 1, h, d)
-
-
-def _smem_space():
-    from jax.experimental.pallas import tpu as pltpu
-
-    return pltpu.SMEM
 
 
 def reference_decode(q, ck, cv, idx):

@@ -1,0 +1,322 @@
+"""Pallas TPU fused 1x1-conv GEMM with BatchNorm-training epilogues.
+
+The training-MFU profile (PERF.md "Training MFU") shows ResNet50 training
+is bandwidth-bound on BN-*training* passes: XLA materializes each conv
+output to HBM, re-reads it to reduce batch statistics, and re-reads it
+again to normalize — three full passes over 56²-stage activations that a
+GPU reference hides behind cuDNN's fused BN kernels (SURVEY.md 2.18's
+libtensorflow dispatch). A 1x1 conv in NHWC is exactly a GEMM
+([N·H·W, Cin] @ [Cin, Cout]) — ~2/3 of ResNet50's conv layers — so this
+kernel owns that GEMM and fuses the BN work into its memory traffic:
+
+* **input epilogue** — the previous BN's normalize+ReLU is applied to x
+  tiles after the VMEM load (``y = relu(scale·x + shift) @ w``), so
+  normalized activations never exist in HBM;
+* **stat epilogue** — per-channel ``Σy`` and ``Σy²`` accumulate across M
+  tiles into a [2, Cout] output, so THIS layer's BN statistics cost no
+  extra pass.
+
+Per 1x1-conv layer that replaces (normalize pass + conv + stats pass)
+with one kernel whose HBM traffic is read-x + read-w + write-y.
+
+The custom VJP keeps the backward in plain jnp: both backward GEMMs take
+elementwise-adjusted operands (``dY' = dy + dΣ + 2y·dΣ²``, recomputed
+``a = relu(scale·x+shift)``) and XLA fuses those producers into the dot
+reads, so no extra HBM pass materializes there either.
+
+CPU (tests / virtual mesh): kernels run in Pallas interpreter mode
+automatically, same convention as ops/flash_attention.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+@dataclasses.dataclass(frozen=True)
+class _Config:
+    """Static kernel configuration (hashable: custom_vjp nondiff arg)."""
+
+    relu_in: bool
+    has_affine: bool
+    has_bias: bool
+    block_m: int
+    block_n: int
+    block_k: int
+    interpret: bool
+
+
+from sparkdl_tpu.ops._pallas import auto_interpret as _auto_interpret
+from sparkdl_tpu.ops._pallas import vmem as _vmem
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(x_ref, w_ref, scale_ref, shift_ref, bias_ref,
+                y_ref, stats_ref, acc_scr, *, cfg: _Config, m_true: int):
+    """Grid (j, i, k): k innermost accumulates the GEMM in f32 scratch;
+    for fixed j the i sweep revisits the [2, bn] stats block consecutively,
+    so the epilogue accumulates partial channel sums in VMEM."""
+    j, i, k = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(k == 0)
+    def _init_acc():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    x = x_ref[:]  # [bm, bk] storage dtype
+    if cfg.has_affine:
+        # previous layer's BN-normalize (+ReLU) fused into the load: the
+        # f32 affine runs on the VPU against tiles already in VMEM
+        a = x.astype(jnp.float32) * scale_ref[0] + shift_ref[0]
+        if cfg.relu_in:
+            a = jnp.maximum(a, 0.0)
+        x = a.astype(x_ref.dtype)
+    elif cfg.relu_in:
+        x = jnp.maximum(x, 0)
+    # operands stay bf16 into the MXU with f32 accumulate (PERF.md:
+    # upcasting first forces 6-pass f32 matmuls)
+    acc_scr[:] += jax.lax.dot_general(
+        x, w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(k == nk - 1)
+    def _epilogue():
+        y = acc_scr[:]
+        if cfg.has_bias:
+            y = y + bias_ref[0]
+        bm = y.shape[0]
+        if m_true % bm != 0:
+            # zero padded rows so they cannot pollute the channel stats
+            rows = i * bm + jax.lax.broadcasted_iota(
+                jnp.int32, y.shape, 0
+            )
+            y = jnp.where(rows < m_true, y, 0.0)
+        y_ref[:] = y.astype(y_ref.dtype)
+        part = jnp.stack(
+            [jnp.sum(y, axis=0), jnp.sum(y * y, axis=0)]
+        )  # [2, bn] f32
+
+        @pl.when(i == 0)
+        def _first():
+            stats_ref[:] = part
+
+        @pl.when(i != 0)
+        def _rest():
+            stats_ref[:] += part
+
+
+def _fwd_call(x, w, scale, shift, bias, cfg: _Config):
+    m, k_dim = x.shape
+    n = w.shape[1]
+    bm = min(cfg.block_m, _ceil_to(m, 16))
+    bk = min(cfg.block_k, _ceil_to(k_dim, 128))
+    bn = min(cfg.block_n, _ceil_to(n, 128))
+    mp, kp, np_ = _ceil_to(m, bm), _ceil_to(k_dim, bk), _ceil_to(n, bn)
+    xp = x
+    if (mp, kp) != (m, k_dim):
+        xp = jnp.pad(x, ((0, mp - m), (0, kp - k_dim)))
+    wp = w
+    if (kp, np_) != (k_dim, n):
+        wp = jnp.pad(w, ((0, kp - k_dim), (0, np_ - n)))
+
+    def pad1(v, size, fill=0.0):
+        if v.shape[0] != size:
+            v = jnp.pad(v, (0, size - v.shape[0]),
+                        constant_values=fill)
+        return v.reshape(1, size).astype(jnp.float32)
+
+    # affine defaults keep padded-K lanes inert: scale 0 ⇒ padded columns
+    # of x contribute shift only... so shift must also be 0 there; relu of
+    # 0 is 0; padded x rows/cols are zero, so identity is safe too.
+    scale2 = pad1(scale if scale is not None else jnp.ones(k_dim), kp)
+    shift2 = pad1(shift if shift is not None else jnp.zeros(k_dim), kp)
+    bias2 = pad1(bias if bias is not None else jnp.zeros(n), np_)
+
+    grid = (np_ // bn, mp // bm, kp // bk)
+    kernel = functools.partial(_fwd_kernel, cfg=cfg, m_true=m)
+    y, stats = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda j, i, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda j, i, k: (k, j)),
+            pl.BlockSpec((1, bk), lambda j, i, k: (0, k)),
+            pl.BlockSpec((1, bk), lambda j, i, k: (0, k)),
+            pl.BlockSpec((1, bn), lambda j, i, k: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda j, i, k: (i, j)),
+            pl.BlockSpec((2, bn), lambda j, i, k: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, np_), x.dtype),
+            jax.ShapeDtypeStruct((2, np_), jnp.float32),
+        ],
+        scratch_shapes=[_vmem((bm, bn), jnp.float32)],
+        interpret=cfg.interpret,
+    )(xp, wp, scale2, shift2, bias2)
+    return y[:m, :n], stats[0, :n], stats[1, :n]
+
+
+# ---------------------------------------------------------------------------
+# public op with custom VJP
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def gemm_bn_stats(x, w, scale, shift, bias, cfg: _Config):
+    """``y = act(scale·x + shift) @ w + bias`` plus per-channel (Σy, Σy²).
+
+    ``act`` is ReLU when ``cfg.relu_in`` (the fused previous-BN epilogue);
+    scale/shift/bias may be None per cfg flags. Returns (y, ysum, ysq).
+    """
+    return _fwd_call(x, w, scale, shift, bias, cfg)
+
+
+def _fwd_rule(x, w, scale, shift, bias, cfg: _Config):
+    y, ysum, ysq = _fwd_call(x, w, scale, shift, bias, cfg)
+    return (y, ysum, ysq), (x, w, scale, shift, bias, y)
+
+
+def _bwd_rule(cfg: _Config, res, grads):
+    """Backward in the storage dtype: every [M, N]/[M, K]-sized
+    intermediate that XLA must materialize (dY', dpre) is cast to
+    ``x.dtype`` at its producer — f32 versions of these arrays measured
+    as the dominant HBM sinks of the whole train step on chip. The tiny
+    per-channel reductions still accumulate in f32."""
+    x, w, scale, shift, bias, y = res
+    dy, dsum, dsq = grads
+    f32 = jnp.float32
+    lp = x.dtype
+    # stats cotangents fold into an adjusted dY'; XLA fuses this
+    # elementwise producer into both backward GEMM reads
+    dyp = (dy.astype(f32) + dsum.astype(f32)[None, :]
+           + 2.0 * y.astype(f32) * dsq.astype(f32)[None, :]).astype(lp)
+
+    if cfg.has_affine:
+        pre = (x.astype(f32) * scale[None, :]
+               + shift[None, :]).astype(lp)
+        a = jnp.maximum(pre, 0) if cfg.relu_in else pre
+    elif cfg.relu_in:
+        a = jnp.maximum(x, 0)
+    else:
+        a = x
+
+    dw = jax.lax.dot_general(
+        a, dyp, (((0,), (0,)), ((), ())),
+        preferred_element_type=f32,
+    ).astype(w.dtype)
+    dbias = (jnp.sum(dyp.astype(f32), axis=0).astype(bias.dtype)
+             if bias is not None else None)
+
+    da = jax.lax.dot_general(
+        dyp, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=f32,
+    ).astype(lp)
+    if cfg.has_affine:
+        dpre = (jnp.where(pre > 0, da, jnp.zeros((), lp))
+                if cfg.relu_in else da)
+        dscale = jnp.sum(dpre.astype(f32) * x.astype(f32),
+                         axis=0).astype(scale.dtype)
+        dshift = jnp.sum(dpre.astype(f32), axis=0).astype(shift.dtype)
+        dx = (dpre * scale[None, :].astype(lp)).astype(x.dtype)
+    else:
+        dpre = (jnp.where(x > 0, da, jnp.zeros((), lp))
+                if cfg.relu_in else da)
+        dscale = dshift = None
+        dx = dpre.astype(x.dtype)
+    return dx, dw, dscale, dshift, dbias
+
+
+gemm_bn_stats.defvjp(_fwd_rule, _bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# layer-level wrapper: 1x1 conv + BN-train statistics
+# ---------------------------------------------------------------------------
+
+
+def conv1x1_bn_stats(
+    x, w, bias=None, *,
+    prev_bn=None, relu_in: bool = False, stride: int = 1,
+    block_m: int = 512, block_n: int = 256, block_k: int = 512,
+    interpret: "bool | None" = None,
+):
+    """Fused NHWC 1x1 conv with BN-training epilogues.
+
+    ``x``: [B, H, W, Cin] (RAW pre-normalize activation when ``prev_bn``
+    is given). ``w``: [1, 1, Cin, Cout] or [Cin, Cout]. ``prev_bn`` =
+    (mean, var, gamma, beta, eps) of the BN that normalizes x; its
+    normalize (+ReLU when ``relu_in``) runs inside the kernel. Returns
+    ``(y, batch_mean, batch_var)`` with y [B, H', W', Cout] and the
+    biased batch moments this layer's BN needs (computed from the f32
+    accumulator — one epilogue instead of a full HBM pass).
+    """
+    if w.ndim == 4:
+        if w.shape[:2] != (1, 1):
+            raise ValueError(f"not a 1x1 kernel: {w.shape}")
+        w = w[0, 0]
+    if stride != 1:
+        x = x[:, ::stride, ::stride, :]
+    b, h, wd, cin = x.shape
+    scale = shift = None
+    if prev_bn is not None:
+        mean, var, gamma, beta, eps = prev_bn
+        scale = (gamma * jax.lax.rsqrt(var + eps)).astype(jnp.float32)
+        shift = (beta - mean * scale).astype(jnp.float32)
+    cfg = _Config(
+        relu_in=relu_in,
+        has_affine=prev_bn is not None,
+        has_bias=bias is not None,
+        block_m=block_m, block_n=block_n, block_k=block_k,
+        interpret=_auto_interpret() if interpret is None else interpret,
+    )
+    y, ysum, ysq = gemm_bn_stats(
+        x.reshape(b * h * wd, cin), w, scale, shift, bias, cfg
+    )
+    m = b * h * wd
+    mean_y = ysum / m
+    var_y = jnp.maximum(ysq / m - mean_y * mean_y, 0.0)
+    return y.reshape(b, h, wd, w.shape[1]), mean_y, var_y
+
+
+def reference_conv1x1_bn_stats(x, w, bias=None, *, prev_bn=None,
+                               relu_in=False, stride=1):
+    """Plain-jnp oracle for the fused op (tests; also documents the math)."""
+    if w.ndim == 4:
+        w = w[0, 0]
+    if stride != 1:
+        x = x[:, ::stride, ::stride, :]
+    a = x.astype(jnp.float32)
+    if prev_bn is not None:
+        mean, var, gamma, beta, eps = prev_bn
+        scale = gamma * jax.lax.rsqrt(var + eps)
+        a = a * scale[None, None, None, :] + (beta - mean * scale)
+    if relu_in:
+        a = jnp.maximum(a, 0.0)
+    y = jax.lax.dot_general(
+        a.astype(x.dtype), w, (((3,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if bias is not None:
+        y = y + bias
+    m = y.shape[0] * y.shape[1] * y.shape[2]
+    mean_y = jnp.sum(y, axis=(0, 1, 2)) / m
+    var_y = jnp.maximum(
+        jnp.sum(y * y, axis=(0, 1, 2)) / m - mean_y * mean_y, 0.0
+    )
+    return y.astype(x.dtype), mean_y, var_y

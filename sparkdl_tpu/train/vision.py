@@ -33,15 +33,11 @@ def vision_loss_fn(model) -> Callable:
     return loss_fn
 
 
-def make_vision_train_step(model, tx: optax.GradientTransformation,
-                           *, donate: bool = False) -> Callable:
-    """Jitted ``step(params, batch_stats, opt_state, x, y) ->
-    (params, batch_stats, opt_state, loss)`` for a BatchNorm CNN.
-
-    ``donate=True`` donates the state arguments (benchmark/steady-state
-    loops where the caller always rebinds them).
-    """
-    loss_fn = vision_loss_fn(model)
+def _make_step(loss_fn: Callable, tx: optax.GradientTransformation,
+               donate: bool) -> Callable:
+    """Shared SGD step over a ``loss_fn(params, batch_stats, x, y) ->
+    (loss, new_batch_stats)`` — one definition for the plain and fused
+    ResNet paths so grad/update mechanics cannot drift apart."""
 
     def step(params: Any, batch_stats: Any, opt_state: Any, x, y):
         (loss, new_stats), grads = jax.value_and_grad(
@@ -51,3 +47,40 @@ def make_vision_train_step(model, tx: optax.GradientTransformation,
         return optax.apply_updates(params, updates), new_stats, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def make_resnet50_fused_train_step(
+    tx: optax.GradientTransformation, *,
+    num_classes: int = 1000,
+    dtype=jnp.bfloat16, donate: bool = False,
+) -> Callable:
+    """Same contract as :func:`make_vision_train_step` for ResNet50, but
+    through :func:`models.resnet_fused.resnet50_fused_apply` — the Pallas
+    fused-BN-epilogue forward (PERF.md training-MFU work). Operates on the
+    plain ``ResNet50`` variable tree, so params/batch_stats/checkpoints
+    interchange with the unfused step. Always the classification head
+    (the loss needs probabilities)."""
+    from sparkdl_tpu.models.resnet_fused import resnet50_fused_apply
+
+    def loss_fn(params, batch_stats, x, y):
+        (_, probs), new_stats = resnet50_fused_apply(
+            {"params": params, "batch_stats": batch_stats}, x,
+            train=True, num_classes=num_classes,
+            include_top=True, dtype=dtype,
+        )
+        logp = jnp.log(jnp.clip(probs, 1e-8))
+        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+        return loss, new_stats
+
+    return _make_step(loss_fn, tx, donate)
+
+
+def make_vision_train_step(model, tx: optax.GradientTransformation,
+                           *, donate: bool = False) -> Callable:
+    """Jitted ``step(params, batch_stats, opt_state, x, y) ->
+    (params, batch_stats, opt_state, loss)`` for a BatchNorm CNN.
+
+    ``donate=True`` donates the state arguments (benchmark/steady-state
+    loops where the caller always rebinds them).
+    """
+    return _make_step(vision_loss_fn(model), tx, donate)

@@ -1,0 +1,89 @@
+"""max_pool backward oracle: must match XLA's select_and_scatter gradient
+exactly — including first-occurrence tie-breaking on plateaus (the relu
+zero-plateau case that real CNNs hit constantly)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from sparkdl_tpu.ops.pooling import max_pool
+
+rng = np.random.default_rng(11)
+
+
+def _xla_pool(x, window, strides):
+    return nn.max_pool(x, (window, window), (strides, strides), "VALID")
+
+
+@pytest.mark.parametrize("shape,window,strides", [
+    ((2, 9, 9, 8), 3, 2),    # the ResNet50/Inception stem shape class
+    ((2, 8, 8, 4), 2, 2),
+    ((1, 10, 7, 3), 3, 1),   # overlapping windows, ragged extent
+])
+def test_forward_matches_flax(shape, window, strides):
+    x = rng.standard_normal(shape).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(max_pool(x, window, strides)),
+        np.asarray(_xla_pool(x, window, strides)),
+    )
+
+
+@pytest.mark.parametrize("shape,window,strides", [
+    ((2, 9, 9, 8), 3, 2),
+    ((2, 8, 8, 4), 2, 2),
+    ((1, 10, 7, 3), 3, 1),
+])
+def test_backward_matches_select_and_scatter(shape, window, strides):
+    x = rng.standard_normal(shape).astype(np.float32)
+
+    def loss_ours(x):
+        y = max_pool(x, window, strides)
+        return jnp.sum(y * jnp.arange(y.size).reshape(y.shape))
+
+    def loss_xla(x):
+        y = _xla_pool(x, window, strides)
+        return jnp.sum(y * jnp.arange(y.size).reshape(y.shape))
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(loss_ours)(x)),
+        np.asarray(jax.grad(loss_xla)(x)),
+        atol=1e-6,
+    )
+
+
+def test_backward_tie_breaking_matches_xla():
+    """Plateaus (equal maxima in a window) must send the gradient to the
+    same single position XLA's GE-select picks — first in row-major
+    order. A relu'd feature map is mostly exact zeros, so this is the
+    common case, not a corner."""
+    x = np.zeros((1, 8, 8, 2), np.float32)
+    x[0, 2, 3, 0] = 1.0  # one real max; everything else ties at 0
+    x[0, 5, 5, 1] = -1.0  # a window where ALL entries tie (at 0)
+
+    def loss(pool):
+        def f(x):
+            y = pool(x)
+            return jnp.sum(y * (1.0 + jnp.arange(y.size).reshape(y.shape)))
+        return f
+
+    g_ours = jax.grad(loss(lambda a: max_pool(a, 3, 2)))(x)
+    g_xla = jax.grad(loss(lambda a: _xla_pool(a, 3, 2)))(x)
+    np.testing.assert_allclose(np.asarray(g_ours), np.asarray(g_xla),
+                               atol=0)
+
+
+def test_backward_under_jit_bf16():
+    x = jnp.bfloat16(rng.standard_normal((2, 9, 9, 8)))
+
+    @jax.jit
+    def loss(x):
+        return jnp.sum(max_pool(x, 3, 2))
+
+    g = jax.grad(loss)(x)
+    assert g.dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(g, np.float32)).all()
