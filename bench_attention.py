@@ -16,6 +16,50 @@ import time
 import numpy as np
 
 
+def scan_time(fn, operands, steps, repeats=3):
+    """Per-step time with ``steps`` calls chained INSIDE one jit: a
+    ~ms-scale program is invisible under this relay's ~2.4 ms
+    per-dispatch overhead and ~70 ms trailing-read RTT, so the benched
+    unit is a scan whose device work dwarfs both (PERF.md
+    measurement-discipline section): R dispatches of M scanned steps,
+    one forced read, minus an explicitly measured empty-dispatch
+    baseline. The first-operand perturbation depends on the loop index,
+    so XLA cannot CSE the iterations. ``fn(*operands) -> summable``."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    first, rest = operands[0], operands[1:]
+
+    @jax.jit
+    def many(first, *rest):
+        def body(acc, i):
+            ff = first + (i * first.dtype.type(1e-8))
+            return acc + fn(ff, *rest), None
+        acc, _ = lax.scan(body, jnp.float32(0), jnp.arange(steps))
+        return acc
+
+    @jax.jit
+    def trivial(x):
+        return x.astype(jnp.float32).ravel()[0]
+
+    float(many(first, *rest))  # compile + drain
+    float(trivial(first))
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = many(first, *rest)
+    float(out)  # forced scalar read pins the chain
+    dt = time.perf_counter() - t0
+    # fixed-cost baseline: same dispatch count + trailing read,
+    # near-zero device work
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        z = trivial(first)
+    float(z)
+    base = time.perf_counter() - t0
+    return max(dt - base, 1e-9) / (steps * repeats)
+
+
 def naive_attention(q, k, v, causal):
     import jax.numpy as jnp
 
@@ -88,55 +132,56 @@ def main() -> None:
         assert bwd_err < 0.5 + 1e-4 * L, f"L={L} bwd diverged: {bwd_err}"
         max_err = max(max_err, fwd_err)
 
-        def timeit(grad_fn):
-            """Per-step time with M grad steps chained INSIDE one jit:
-            a 3 ms program is invisible under this relay's ~2.4 ms
-            per-dispatch overhead and ~70 ms trailing-read RTT, so the
-            benched unit is a scan whose device work dwarfs both (PERF.md
-            measurement-discipline section): R dispatches of M scanned
-            steps, one forced read, minus an explicitly measured
-            empty-dispatch baseline. The input perturbation depends on
-            the loop index, so XLA cannot CSE the iterations."""
-            from jax import lax
+        def grad_step(grad_fn):
+            return lambda qq, kk, vv: grad_fn(qq, kk, vv)[0].astype(
+                jnp.float32).sum()
 
-            M, R = steps, 3
-
-            @jax.jit
-            def many(q, k, v):
-                def body(acc, i):
-                    qq = q + (i * jnp.bfloat16(1e-8))
-                    g = grad_fn(qq, k, v)
-                    return acc + g[0].astype(jnp.float32).sum(), None
-                acc, _ = lax.scan(body, jnp.float32(0), jnp.arange(M))
-                return acc
-
-            @jax.jit
-            def trivial(q):
-                return q.astype(jnp.float32).ravel()[0]
-
-            float(many(q, k, v))  # compile + drain
-            float(trivial(q))
-            t0 = time.perf_counter()
-            for _ in range(R):
-                out = many(q, k, v)
-            float(out)  # forced scalar read pins the chain
-            dt = time.perf_counter() - t0
-            # fixed-cost baseline: same dispatch count + trailing read,
-            # near-zero device work
-            t0 = time.perf_counter()
-            for _ in range(R):
-                z = trivial(q)
-            float(z)
-            base = time.perf_counter() - t0
-            return max(dt - base, 1e-9) / (M * R)
-
-        t_flash = timeit(jax.grad(flash_loss, argnums=(0, 1, 2)))
-        t_naive = timeit(jax.grad(naive_loss, argnums=(0, 1, 2)))
+        t_flash = scan_time(
+            grad_step(jax.grad(flash_loss, argnums=(0, 1, 2))),
+            (q, k, v), steps)
+        t_naive = scan_time(
+            grad_step(jax.grad(naive_loss, argnums=(0, 1, 2))),
+            (q, k, v), steps)
         results[L] = {
             "flash_ms": round(t_flash * 1e3, 2),
             "naive_ms": round(t_naive * 1e3, 2),
             "speedup": round(t_naive / t_flash, 2),
         }
+
+    # ---- decode row: single-query cached attention (serving hot loop) --
+    from sparkdl_tpu.ops.flash_decode import flash_decode, reference_decode
+
+    Ld = max(lengths)
+    bd = 8  # serving-shaped batch
+    rng = np.random.default_rng(7)
+    qd = jnp.asarray(rng.standard_normal((bd, 1, h, d)), jnp.bfloat16)
+    ck = jnp.asarray(rng.standard_normal((bd, Ld, h, d)), jnp.bfloat16)
+    cv = jnp.asarray(rng.standard_normal((bd, Ld, h, d)), jnp.bfloat16)
+    idx = Ld - 1
+
+    err = float(jnp.max(jnp.abs(
+        flash_decode(qd, ck, cv, idx, interpret=interpret)
+        .astype(jnp.float32)
+        - reference_decode(qd, ck, cv, idx).astype(jnp.float32))))
+    # same hardware-proof contract as the attention rows: a numerically
+    # wrong kernel must fail the bench, not print a speedup
+    assert err < 0.05, f"decode diverged: {err}"
+    max_err = max(max_err, err)
+
+    t_fd = scan_time(
+        lambda q, k_, v_: flash_decode(q, k_, v_, idx,
+                                       interpret=interpret)
+        .astype(jnp.float32).sum(),
+        (qd, ck, cv), steps)
+    t_dd = scan_time(
+        lambda q, k_, v_: reference_decode(q, k_, v_, idx)
+        .astype(jnp.float32).sum(),
+        (qd, ck, cv), steps)
+    results[f"decode_L{Ld}"] = {
+        "flash_ms": round(t_fd * 1e3, 3),
+        "dense_ms": round(t_dd * 1e3, 3),
+        "speedup": round(t_dd / t_fd, 2),
+    }
 
     headline = max(lengths)
     print(json.dumps({

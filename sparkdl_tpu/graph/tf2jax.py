@@ -332,29 +332,39 @@ def _register_simple():
 
     # -- conv / bn / bias ------------------------------------------------
     def _conv_common(node, x, kernel, feature_group_count=1):
+        # NCHW graphs (GPU-era frozen models) translate by transposing to
+        # the TPU-native NHWC layout around the conv; XLA's layout
+        # assignment folds the transposes, so this costs nothing at run
+        # time and keeps one conv code path.
         fmt = _attr(node, "data_format", "NHWC")
-        if fmt != "NHWC":
+        if fmt not in ("NHWC", "NCHW"):
             raise GraphTranslationError(
-                f"node {node.name!r}: data_format {fmt} unsupported "
-                "(NHWC only — the TPU-native layout)"
+                f"node {node.name!r}: data_format {fmt} unsupported"
             )
+        nchw = fmt == "NCHW"
         strides = _attr(node, "strides", [1, 1, 1, 1])
         dil = _attr(node, "dilations", [1, 1, 1, 1])
+        hw = slice(2, 4) if nchw else slice(1, 3)
         padding = _attr(node, "padding", "VALID")
         if padding == "EXPLICIT":
             ep = _attr(node, "explicit_paddings", [])
-            pads = [(ep[2], ep[3]), (ep[4], ep[5])]
+            # explicit_paddings follows the data_format's dim order
+            pads = ([(ep[4], ep[5]), (ep[6], ep[7])] if nchw
+                    else [(ep[2], ep[3]), (ep[4], ep[5])])
         else:
             pads = padding
-        return lax.conv_general_dilated(
+        if nchw:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        out = lax.conv_general_dilated(
             x, kernel,
-            window_strides=strides[1:3],
+            window_strides=strides[hw],
             padding=pads,
-            rhs_dilation=dil[1:3],
+            rhs_dilation=dil[hw],
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=feature_group_count,
             precision=_prec(x, kernel),
         )
+        return jnp.transpose(out, (0, 3, 1, 2)) if nchw else out
 
     @_op("Conv2D")
     def _conv2d(xp, node, x, kernel):
@@ -376,7 +386,11 @@ def _register_simple():
                 )
             eps = _attr(node, "epsilon", 1e-3)
             inv = lax.rsqrt(var + eps) * scale
-            return (x - mean) * inv + offset
+            shift = offset - mean * inv
+            if _attr(node, "data_format", "NHWC") == "NCHW":
+                inv = inv.reshape(-1, 1, 1)
+                shift = shift.reshape(-1, 1, 1)
+            return x * inv + shift
 
     @_op("BiasAdd")
     def _bias(xp, node, x, b):
@@ -387,12 +401,14 @@ def _register_simple():
     # -- pooling ---------------------------------------------------------
     def _pool(node, x, reducer, init):
         fmt = _attr(node, "data_format", "NHWC")
-        if fmt != "NHWC":
+        if fmt not in ("NHWC", "NCHW"):
             raise GraphTranslationError(
                 f"node {node.name!r}: data_format {fmt} unsupported")
         ks = _attr(node, "ksize", [1, 1, 1, 1])
         st = _attr(node, "strides", [1, 1, 1, 1])
         pad = _attr(node, "padding", "VALID")
+        # window/stride attrs follow the data_format's dim order, and
+        # reduce_window is layout-agnostic — no transpose needed
         return lax.reduce_window(
             x, init, reducer, tuple(ks), tuple(st), pad
         )
@@ -405,11 +421,12 @@ def _register_simple():
 
     @_op("AvgPool")
     def _avgpool(xp, node, x):
-        # TF divides by the count of non-padded cells in each window
+        # TF divides by the count of non-padded cells in each window;
+        # counting via a pooled all-ones constant is layout-agnostic
+        # (works for NHWC and NCHW alike) and folds at compile time
         s = _pool(node, x, lax.add, 0.0 if
                   jnp.issubdtype(x.dtype, jnp.floating) else 0)
-        ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
-        cnt = _pool(node, jnp.broadcast_to(ones, x.shape), lax.add, 0.0)
+        cnt = _pool(node, jnp.ones(x.shape, x.dtype), lax.add, 0.0)
         return s / cnt
 
     # -- reductions ------------------------------------------------------

@@ -10,12 +10,11 @@ whose 1x1 convs run through :func:`ops.fused_gemm_bn.conv1x1_bn_stats`:
 * every 1x1 conv emits its BN's batch moments from the GEMM accumulator
   (no stats pass over the conv output);
 * the 3x3→1x1 seam fuses the 3x3's BN-normalize+ReLU into the 1x1's
-  operand load (normalized activations never hit HBM);
-* max-pool routes through ops/pooling.max_pool (no select_and_scatter in
-  the backward).
+  operand load (normalized activations never hit HBM).
 
-The 7x7 stem and the 3x3 convs stay on XLA's convolution lowering, which
-is where it is already strong. Numerics: batch moments come from the f32
+The 7x7 stem, the 3x3 convs, and max-pool stay on XLA's lowerings,
+which is where they are already strong (the gather-form pooling
+backward in ops/pooling.py measured slower — see its docstring). Numerics: batch moments come from the f32
 GEMM accumulator rather than a bf16 re-read — equal in f32, and within
 bf16 rounding otherwise (the oracle test pins both).
 """
@@ -33,6 +32,14 @@ from sparkdl_tpu.ops.fused_gemm_bn import conv1x1_bn_stats
 
 _BN_EPS = 1.001e-5
 _MOMENTUM = 0.99
+
+import os as _os
+
+#: Pallas-kernel gate: fused 1x1s with Cin below this go through XLA
+#: (lane-starved shapes measured 4.7x slower — PERF.md round 3). Read
+#: ONCE at import: the forward is jit-traced, so a later env change
+#: could never take effect anyway.
+_FUSED_MIN_CIN = int(_os.environ.get("SPARKDL_FUSED_MIN_CIN", "128"))
 
 #: (filters, blocks, stride) per stage — resnet.py's stack calls
 _STAGES = ((64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2))
@@ -123,10 +130,7 @@ def resnet50_fused_apply(
         """
         p = params[name]
         cin = y.shape[-1]
-        import os as _os
-
-        min_cin = int(_os.environ.get("SPARKDL_FUSED_MIN_CIN", "128"))
-        use_kernel = train and stride == 1 and cin >= min_cin
+        use_kernel = train and stride == 1 and cin >= _FUSED_MIN_CIN
         if use_kernel:
             out, mean, var = conv1x1_bn_stats(
                 y, p["kernel"].astype(dtype), p["bias"],

@@ -50,7 +50,7 @@ def _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(live)
     def _attend():
-        q = q_ref[:]  # [1, D]
+        q = q_ref[0]  # [1, D]
         k = k_ref[0]  # [bk, D]
         s = jnp.sum(
             k.astype(jnp.float32) * q.astype(jnp.float32), axis=-1,
@@ -75,7 +75,7 @@ def _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(ki == nk - 1)
     def _finish():
-        o_ref[:] = (acc_scr[:] / l_scr[0, 0]).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:] / l_scr[0, 0]).astype(o_ref.dtype)
 
 
 def flash_decode(q, ck, cv, idx, *, block_k: int = 512,
@@ -98,8 +98,13 @@ def flash_decode(q, ck, cv, idx, *, block_k: int = 512,
     bk = min(block_k, lmax)
     if lmax % bk:
         bk = math.gcd(lmax, bk)
+    if bk % 8 and bk != lmax:
+        bk = lmax  # Mosaic: sublane block dim must be 8-divisible or full
 
-    qf = q.reshape(b, h, d).reshape(b * h, d)
+    # rank-3 views with a singleton middle dim: Mosaic requires the last
+    # two block dims to be (8-divisible | full); (1, d) blocks on a 2D
+    # array violate that, (1, 1, d) blocks on [BH, 1, D] are legal
+    qf = q.reshape(b, h, d).reshape(b * h, 1, d)
     # [B, L, H, D] -> [B*H, L, D]
     kf = ck.transpose(0, 2, 1, 3).reshape(b * h, lmax, d)
     vf = cv.transpose(0, 2, 1, 3).reshape(b * h, lmax, d)
@@ -110,12 +115,12 @@ def flash_decode(q, ck, cv, idx, *, block_k: int = 512,
         grid=(b * h, lmax // bk),
         in_specs=[
             pl.BlockSpec(memory_space=_smem_space()),
-            pl.BlockSpec((1, d), lambda i, ki: (i, 0)),
+            pl.BlockSpec((1, 1, d), lambda i, ki: (i, 0, 0)),
             pl.BlockSpec((1, bk, d), lambda i, ki: (i, ki, 0)),
             pl.BlockSpec((1, bk, d), lambda i, ki: (i, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, d), lambda i, ki: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, d), q.dtype),
+        out_specs=pl.BlockSpec((1, 1, d), lambda i, ki: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
         scratch_shapes=[
             _vmem((1, d), jnp.float32),
             _smem((1, 1), jnp.float32),

@@ -17,6 +17,13 @@ import jax.numpy as jnp
 import optax
 
 
+def _nll_from_probs(probs, y):
+    """The zoo models output probabilities; one NLL definition so the
+    plain and fused paths stay numerically comparable."""
+    logp = jnp.log(jnp.clip(probs, 1e-8))
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
 def vision_loss_fn(model) -> Callable:
     """Cross-entropy loss over a zoo model's ``(features, probs)`` output;
     returns ``(loss, new_batch_stats)``."""
@@ -26,9 +33,7 @@ def vision_loss_fn(model) -> Callable:
             {"params": params, "batch_stats": batch_stats},
             x, train=True, mutable=["batch_stats"],
         )
-        logp = jnp.log(jnp.clip(probs, 1e-8))
-        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
-        return loss, updates["batch_stats"]
+        return _nll_from_probs(probs, y), updates["batch_stats"]
 
     return loss_fn
 
@@ -68,9 +73,7 @@ def make_resnet50_fused_train_step(
             train=True, num_classes=num_classes,
             include_top=True, dtype=dtype,
         )
-        logp = jnp.log(jnp.clip(probs, 1e-8))
-        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
-        return loss, new_stats
+        return _nll_from_probs(probs, y), new_stats
 
     return _make_step(loss_fn, tx, donate)
 

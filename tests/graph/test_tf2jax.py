@@ -397,6 +397,57 @@ def test_reduction_empty_axis_list_is_identity():
     _check(build, x_np)
 
 
+def test_nchw_graph_translates():
+    """GPU-era frozen graphs use NCHW; conv/BN/pool/bias all translate
+    (transposed around the conv — XLA folds the transposes). TF on CPU
+    often cannot EXECUTE NCHW convs, so when the session refuses, the
+    oracle falls back to the NHWC-equivalent computation."""
+    x_np = rng.standard_normal((2, 3, 10, 10)).astype(np.float32)  # NCHW
+    k_np = (rng.standard_normal((3, 3, 3, 8)) * 0.2).astype(np.float32)
+    bias_np = rng.standard_normal(8).astype(np.float32)
+    mean_np = rng.standard_normal(8).astype(np.float32) * 0.1
+    var_np = np.abs(rng.standard_normal(8)).astype(np.float32) + 0.5
+    gamma_np = np.ones(8, np.float32)
+    beta_np = np.zeros(8, np.float32)
+
+    def build():
+        x = v1.placeholder(tf.float32, [None, 3, 10, 10], name="x")
+        h = tf.nn.conv2d(x, tf.constant(k_np), strides=[1, 1, 1, 1],
+                         padding="SAME", data_format="NCHW")
+        h = tf.nn.bias_add(h, tf.constant(bias_np), data_format="NCHW")
+        h, _, _ = tf.compat.v1.nn.fused_batch_norm(
+            h, tf.constant(gamma_np), tf.constant(beta_np),
+            tf.constant(mean_np), tf.constant(var_np),
+            epsilon=1e-3, is_training=False, data_format="NCHW")
+        h = tf.nn.relu(h)
+        h = tf.nn.max_pool2d(h, 2, 2, "VALID", data_format="NCHW")
+        y = tf.nn.avg_pool2d(h, 3, 1, "SAME", data_format="NCHW",
+                             name="y")
+        return [x], [y]
+
+    gfn, oracle = _freeze(build)
+    assert untranslatable_ops(gfn.graph_def, gfn.output_names) == []
+    fn = translate_graph_def(gfn.graph_def, gfn.input_names,
+                             gfn.output_names)
+    got = np.asarray(jax.jit(lambda a: fn(a)[0])(x_np))
+
+    try:
+        want = np.asarray(oracle(x_np)[0])
+    except Exception:
+        # CPU TF refused NCHW execution: NHWC-equivalent reference
+        xh = np.transpose(x_np, (0, 2, 3, 1))
+        h = tf.nn.conv2d(xh, k_np, strides=[1, 1, 1, 1], padding="SAME")
+        h = tf.nn.bias_add(h, bias_np)
+        h, _, _ = tf.compat.v1.nn.fused_batch_norm(
+            h, gamma_np, beta_np, mean_np, var_np,
+            epsilon=1e-3, is_training=False)
+        h = tf.nn.relu(h)
+        h = tf.nn.max_pool2d(h, 2, 2, "VALID")
+        h = tf.nn.avg_pool2d(h, 3, 1, "SAME")
+        want = np.transpose(h.numpy(), (0, 3, 1, 2))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+
 def test_f32_precision_knob():
     """'highest' (default) and 'default' both execute and agree on CPU
     (the divergence is TPU-only bf16 passes); invalid values raise on
