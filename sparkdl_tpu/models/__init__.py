@@ -21,6 +21,12 @@ from sparkdl_tpu.models.bert import (
     config_from_hf,
     load_hf_bert,
 )
+from sparkdl_tpu.models.vit import (
+    ViTConfig,
+    ViTModel,
+    config_from_hf_vit,
+    load_hf_vit,
+)
 
 __all__ = [
     "SUPPORTED_MODELS",
@@ -40,4 +46,8 @@ __all__ = [
     "BertModel",
     "config_from_hf",
     "load_hf_bert",
+    "ViTConfig",
+    "ViTModel",
+    "config_from_hf_vit",
+    "load_hf_vit",
 ]

@@ -34,6 +34,10 @@ class ModelEntry:
     features_need_top: bool = False
     #: per-type layer ordering for weight conversion (see keras_loader)
     layer_order: str = "topo"
+    #: pretrained-weight source: "keras" (keras.applications + the
+    #: keras_loader converter) or "hf" (a transformers model through the
+    #: family's load_hf_* converter, e.g. models.vit.load_hf_vit)
+    source: str = "keras"
 
 
 def _entries() -> dict[str, ModelEntry]:
@@ -41,6 +45,8 @@ def _entries() -> dict[str, ModelEntry]:
     from sparkdl_tpu.models.resnet import ResNet50
     from sparkdl_tpu.models.vgg import VGG16, VGG19
     from sparkdl_tpu.models.xception import Xception
+
+    from sparkdl_tpu.models.vit import vit_b16_builder
 
     entries = [
         ModelEntry("InceptionV3", InceptionV3, "inception_v3:InceptionV3",
@@ -53,6 +59,11 @@ def _entries() -> dict[str, ModelEntry]:
                    (224, 224), "caffe", 4096, features_need_top=True),
         ModelEntry("VGG19", VGG19, "vgg19:VGG19",
                    (224, 224), "caffe", 4096, features_need_top=True),
+        # beyond-parity: the transformer vision family. HF ViT's default
+        # image processing (rescale 1/255, normalize mean=std=0.5) is
+        # exactly the "tf" preprocess mode.
+        ModelEntry("ViTB16", vit_b16_builder, "",
+                   (224, 224), "tf", 768, source="hf"),
     ]
     return {e.name: e for e in entries}
 
@@ -67,7 +78,8 @@ def registry() -> dict[str, ModelEntry]:
     return _REGISTRY
 
 
-SUPPORTED_MODELS = ("InceptionV3", "Xception", "ResNet50", "VGG16", "VGG19")
+SUPPORTED_MODELS = ("InceptionV3", "Xception", "ResNet50", "VGG16",
+                    "VGG19", "ViTB16")
 
 
 def get_entry(name: str) -> ModelEntry:
@@ -88,6 +100,12 @@ def build_keras_model(entry: ModelEntry, weights: str | None = "imagenet",
     """
     import importlib
 
+    if entry.source != "keras":
+        raise ValueError(
+            f"model {entry.name} has no keras.applications source "
+            f"(source={entry.source!r}); use the family's load_hf_* "
+            "converter for pretrained weights"
+        )
     mod_name, attr = entry.keras_builder_path.split(":")
     mod = importlib.import_module(f"keras.applications.{mod_name}")
     builder = getattr(mod, attr)
@@ -127,6 +145,26 @@ def build_flax_model(name: str, weights: "str | None" = "imagenet",
     module = entry.flax_builder(
         include_top=ktop, dtype=dtype, num_classes=entry.num_classes
     )
+    if entry.source == "hf" and weights is not None:
+        # HF-family pretrained weights load through the family's
+        # load_hf_* converter (e.g. models.vit.load_hf_vit on a
+        # transformers model instance) — the 'imagenet' shortcut is a
+        # keras.applications concept. Only that DEFAULT degrades to
+        # random init (mirroring the zero-egress fallback); an explicit
+        # weights path must fail loudly, never silently random-init.
+        if weights != "imagenet":
+            raise ValueError(
+                f"model {name} sources pretrained weights from HF — "
+                f"weights={weights!r} has no keras.applications loader. "
+                "Convert a transformers model via its load_hf_* "
+                "converter (e.g. models.vit.load_hf_vit) instead."
+            )
+        logger.warning(
+            "model %s sources pretrained weights from HF (use "
+            "models.vit.load_hf_vit on a transformers model); "
+            "weights='imagenet' ignored — using random init", name,
+        )
+        weights = None
     if weights is None:
         h, w = entry.input_size
         variables = module.init(

@@ -1,0 +1,278 @@
+"""Vision Transformer (ViT) family, TPU-first.
+
+Beyond-parity addition: the reference's zoo is ImageNet CNNs (SURVEY.md
+2.1); a complete modern framework needs the transformer vision family
+too. Faithful to the HF ``ViTModel`` computation (google/vit-base-*):
+conv patch embedding, prepended CLS token, learned position embeddings,
+pre-LN encoder blocks with exact (non-tanh) GELU, final LayerNorm.
+
+TPU-first choices, same design language as models/bert.py:
+
+- qkv/out and MLP kernels carry Megatron-style tp sharding metadata
+  (``parallel.tensor_parallel``);
+- ``attn_impl='flash'`` routes the encoder attention through the fused
+  Pallas kernel (no mask needed — ViT sequences are dense);
+- zoo contract: ``module.apply(vars, x, train=False) -> (features,
+  probs)`` so DeepImageFeaturizer/DeepImagePredictor drive it like any
+  named CNN. ``features`` = final-LN CLS token (the HF featurization
+  convention); ``probs`` from the classifier head when ``include_top``.
+
+``load_hf_vit`` converts a transformers ``ViTModel``/
+``ViTForImageClassification`` (torch) into this module's variables —
+oracle-tested feature-level against the torch forward on a shared
+random-init model (tests/models/test_vit.py), the same fidelity story as
+``load_hf_gpt2``/``load_hf_bert``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkdl_tpu.parallel.tensor_parallel import (
+    ColumnParallelDense,
+    RowParallelDense,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    layer_norm_eps: float = 1e-12
+    dropout: float = 0.0
+    #: "full" | "flash" (fused Pallas kernel; dense attention, no mask)
+    attn_impl: str = "full"
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def b16(cls, **kw) -> "ViTConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ViTConfig":
+        """Test-sized config (oracle/unit tests)."""
+        defaults = dict(
+            image_size=32, patch_size=8, hidden_size=32, num_layers=2,
+            num_heads=2, intermediate_size=64, dropout=0.0,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class ViTSelfAttention(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        c = self.config
+        h, nh = c.hidden_size, c.num_heads
+        hd = h // nh
+        q = ColumnParallelDense(h, dtype=c.dtype, name="query")(x)
+        k = ColumnParallelDense(h, dtype=c.dtype, name="key")(x)
+        v = ColumnParallelDense(h, dtype=c.dtype, name="value")(x)
+        b, l = x.shape[0], x.shape[1]
+        q, k, v = (t.reshape(b, l, nh, hd) for t in (q, k, v))
+
+        if c.attn_impl == "flash":
+            if train and c.dropout > 0:
+                # blockwise accumulation never materialises the
+                # probability matrix, so attention-probs dropout cannot
+                # apply on the flash path (same caveat as models/bert.py)
+                import warnings
+
+                warnings.warn(
+                    "attn_impl='flash' skips attention-probs dropout "
+                    f"(p={c.dropout}); set dropout=0 to silence",
+                    stacklevel=2,
+                )
+            from sparkdl_tpu.ops.flash_attention import flash_attention
+
+            ctx = flash_attention(q, k, v)
+        else:
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k,
+                preferred_element_type=jnp.float32,
+            ) / np.sqrt(hd)
+            p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
+            p = nn.Dropout(c.dropout, deterministic=not train)(p)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return RowParallelDense(h, dtype=c.dtype, name="output_dense")(
+            ctx.reshape(b, l, h)
+        )
+
+
+class ViTBlock(nn.Module):
+    """Pre-LN transformer block (the ViT/HF ordering: LN -> attn ->
+    +residual; LN -> MLP -> +residual)."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        c = self.config
+        a = ViTSelfAttention(c, name="attention")(
+            nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
+                         name="layernorm_before")(x),
+            train=train,
+        )
+        x = x + nn.Dropout(c.dropout, deterministic=not train)(a)
+        h = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
+                         name="layernorm_after")(x)
+        h = ColumnParallelDense(c.intermediate_size, dtype=c.dtype,
+                                name="intermediate")(h)
+        h = nn.gelu(h, approximate=False)
+        h = RowParallelDense(c.hidden_size, dtype=c.dtype, name="output")(h)
+        return x + nn.Dropout(c.dropout, deterministic=not train)(h)
+
+
+class ViTModel(nn.Module):
+    """Zoo-contract ViT: ``(features, probs)``; probs None without head.
+
+    ``features`` is the final-LayerNorm CLS token ([B, hidden]).
+    Construction fields mirror ZooModule so the registry builds it like
+    any named model.
+    """
+
+    config: ViTConfig = ViTConfig()
+    num_classes: int = 1000
+    include_top: bool = True
+    dtype: Any = None  # overrides config.dtype when set
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = self.config
+        if self.dtype is not None and self.dtype != c.dtype:
+            c = dataclasses.replace(c, dtype=self.dtype)
+        p = c.patch_size
+        b = x.shape[0]
+        if x.shape[1] != c.image_size or x.shape[2] != c.image_size:
+            raise ValueError(
+                f"ViT expects {c.image_size}x{c.image_size} inputs, got "
+                f"{x.shape[1]}x{x.shape[2]}"
+            )
+        # patch embedding: conv PxP stride P == per-patch linear
+        h = nn.Conv(c.hidden_size, (p, p), strides=(p, p),
+                    padding="VALID", dtype=c.dtype,
+                    param_dtype=jnp.float32, name="patch_embed")(
+            jnp.asarray(x, c.dtype))
+        h = h.reshape(b, -1, c.hidden_size)  # [B, N, H]
+
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, c.hidden_size),
+            jnp.float32,
+        )
+        h = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(c.dtype), (b, 1, c.hidden_size)),
+             h], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, c.num_patches + 1, c.hidden_size), jnp.float32,
+        )
+        h = h + pos.astype(c.dtype)
+        h = nn.Dropout(c.dropout, deterministic=not train)(h)
+
+        for i in range(c.num_layers):
+            h = ViTBlock(c, name=f"layer_{i}")(h, train=train)
+
+        h = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
+                         name="layernorm")(h)
+        features = h[:, 0].astype(jnp.float32)
+        if not self.include_top:
+            return features, None
+        logits = nn.Dense(self.num_classes, dtype=c.dtype,
+                          param_dtype=jnp.float32, name="classifier")(
+            h[:, 0])
+        return features, jax.nn.softmax(logits.astype(jnp.float32))
+
+
+def vit_b16_builder(include_top: bool = True, dtype=jnp.float32,
+                    num_classes: int = 1000) -> ViTModel:
+    """Registry-shaped constructor for ViT-B/16 at 224px."""
+    return ViTModel(
+        config=ViTConfig.b16(dtype=dtype), num_classes=num_classes,
+        include_top=include_top, dtype=dtype,
+    )
+
+
+# ---------------------------------------------------------------------------
+# HuggingFace ViT weight conversion (torch state -> this pytree)
+# ---------------------------------------------------------------------------
+
+def config_from_hf_vit(hf_config) -> ViTConfig:
+    if getattr(hf_config, "hidden_act", "gelu") not in ("gelu",):
+        raise ValueError(
+            f"unsupported ViT activation {hf_config.hidden_act!r}"
+        )
+    return ViTConfig(
+        image_size=hf_config.image_size,
+        patch_size=hf_config.patch_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        intermediate_size=hf_config.intermediate_size,
+        layer_norm_eps=hf_config.layer_norm_eps,
+        dropout=0.0,
+    )
+
+
+def load_hf_vit(hf_model) -> "tuple[ViTConfig, dict]":
+    """Convert a transformers ``ViTModel`` / ``ViTForImageClassification``
+    into (config, variables). Torch Linear stores [out, in] — transposed
+    into flax [in, out]; the patch conv transposes OIHW -> HWIO."""
+    base = getattr(hf_model, "vit", hf_model)
+    cfg = config_from_hf_vit(base.config)
+
+    def _np(t):
+        return np.asarray(t.detach().cpu().numpy())
+
+    def _lin(mod):
+        return {"kernel": _np(mod.weight).T, "bias": _np(mod.bias)}
+
+    def _ln(mod):
+        return {"scale": _np(mod.weight), "bias": _np(mod.bias)}
+
+    emb = base.embeddings
+    params: dict = {
+        "patch_embed": {
+            "kernel": _np(emb.patch_embeddings.projection.weight)
+            .transpose(2, 3, 1, 0),
+            "bias": _np(emb.patch_embeddings.projection.bias),
+        },
+        "cls_token": _np(emb.cls_token),
+        "pos_embed": _np(emb.position_embeddings),
+        "layernorm": _ln(base.layernorm),
+    }
+    for i, layer in enumerate(base.encoder.layer):
+        att = layer.attention.attention
+        params[f"layer_{i}"] = {
+            "layernorm_before": _ln(layer.layernorm_before),
+            "layernorm_after": _ln(layer.layernorm_after),
+            "attention": {
+                "query": _lin(att.query),
+                "key": _lin(att.key),
+                "value": _lin(att.value),
+                "output_dense": _lin(layer.attention.output.dense),
+            },
+            "intermediate": _lin(layer.intermediate.dense),
+            "output": _lin(layer.output.dense),
+        }
+    head = getattr(hf_model, "classifier", None)
+    if head is not None and hasattr(head, "weight"):
+        params["classifier"] = _lin(head)
+    return cfg, {"params": params}

@@ -1,0 +1,152 @@
+"""ViT family: HF weight/feature fidelity, flash parity, zoo contract,
+DeepImageFeaturizer integration."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.vit import (
+    ViTConfig,
+    ViTModel,
+    load_hf_vit,
+)
+
+rng = np.random.default_rng(21)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = ViTConfig.tiny()
+    model = ViTModel(config=cfg, num_classes=5, include_top=True)
+    x = rng.standard_normal((2, 32, 32, 3)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    return cfg, model, variables, x
+
+
+def test_zoo_contract_shapes(tiny):
+    cfg, model, variables, x = tiny
+    features, probs = model.apply(variables, x, train=False)
+    assert features.shape == (2, cfg.hidden_size)
+    assert probs.shape == (2, 5)
+    np.testing.assert_allclose(np.asarray(probs.sum(-1)), 1.0, atol=1e-5)
+
+    headless = ViTModel(config=cfg, include_top=False)
+    feats2, probs2 = headless.apply(
+        {"params": {k: v for k, v in variables["params"].items()
+                    if k != "classifier"}}, x)
+    assert probs2 is None
+    np.testing.assert_allclose(np.asarray(feats2), np.asarray(features),
+                               atol=1e-5)
+
+
+def test_wrong_input_size_rejected(tiny):
+    cfg, model, variables, _ = tiny
+    with pytest.raises(ValueError, match="32x32"):
+        model.apply(variables, jnp.zeros((1, 16, 16, 3)))
+
+
+def test_flash_matches_full(tiny):
+    cfg, model, variables, x = tiny
+    flash = ViTModel(config=ViTConfig.tiny(attn_impl="flash"),
+                     num_classes=5, include_top=True)
+    f_full, p_full = model.apply(variables, x)
+    f_flash, p_flash = flash.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(f_flash), np.asarray(f_full),
+                               atol=2e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(p_flash), np.asarray(p_full),
+                               atol=1e-5)
+
+
+def test_hf_vit_feature_fidelity():
+    """Feature-level parity against the torch ViTModel forward on a
+    shared random-init model (the load_hf_gpt2/bert fidelity story)."""
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+
+    hf_cfg = transformers.ViTConfig(
+        image_size=32, patch_size=8, hidden_size=32,
+        num_hidden_layers=2, num_attention_heads=2,
+        intermediate_size=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    hf = transformers.ViTModel(hf_cfg, add_pooling_layer=False).eval()
+
+    cfg, variables = load_hf_vit(hf)
+    model = ViTModel(config=cfg, include_top=False)
+
+    x = rng.standard_normal((2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = hf(
+            pixel_values=torch.from_numpy(
+                np.transpose(x, (0, 3, 1, 2)))  # HF is NCHW
+        ).last_hidden_state[:, 0].numpy()
+    feats, _ = model.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(feats), want,
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_hf_vit_classifier_probs():
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+
+    hf_cfg = transformers.ViTConfig(
+        image_size=32, patch_size=8, hidden_size=32,
+        num_hidden_layers=2, num_attention_heads=2,
+        intermediate_size=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, num_labels=7,
+    )
+    hf = transformers.ViTForImageClassification(hf_cfg).eval()
+    cfg, variables = load_hf_vit(hf)
+    model = ViTModel(config=cfg, num_classes=7, include_top=True)
+
+    x = rng.standard_normal((2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        logits = hf(pixel_values=torch.from_numpy(
+            np.transpose(x, (0, 3, 1, 2)))).logits.numpy()
+    want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    _, probs = model.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(probs), want,
+                               atol=1e-4, rtol=1e-3)
+
+
+def test_registry_and_featurizer_route():
+    """DeepImageFeaturizer(modelName='ViTB16') drives the ViT like any
+    named CNN (random init — zero-egress; weight fidelity is pinned by
+    the HF oracle above)."""
+    from sparkdl_tpu.dataframe.local import LocalDataFrame
+    from sparkdl_tpu.image.imageIO import imageArrayToStruct
+    from sparkdl_tpu.models.registry import build_flax_model, get_entry
+    from sparkdl_tpu.transformers.named_image import DeepImageFeaturizer
+
+    entry = get_entry("ViTB16")
+    assert entry.input_size == (224, 224) and entry.feature_dim == 768
+
+    rows = [
+        {"image": imageArrayToStruct(
+            (rng.random((40, 40, 3)) * 255).astype(np.uint8))}
+        for _ in range(3)
+    ]
+    df = LocalDataFrame([rows])
+    feat = DeepImageFeaturizer(
+        modelName="ViTB16", inputCol="image", outputCol="features",
+        batchSize=2,
+    )
+    got = feat.transform(df).collect()
+    assert len(got) == 3 and len(got[0]["features"]) == 768
+
+    module, variables = build_flax_model("ViTB16", weights=None,
+                                         include_top=False)
+    f, p = module.apply(
+        variables, jnp.zeros((1, 224, 224, 3), jnp.float32))
+    assert f.shape == (1, 768) and p is None
+
+    # explicit weight paths must fail loudly (no silent random init),
+    # and the keras builder must reject the hf-source entry clearly
+    from sparkdl_tpu.models.registry import build_keras_model
+
+    with pytest.raises(ValueError, match="load_hf_"):
+        build_flax_model("ViTB16", weights="/nope/vit.h5")
+    with pytest.raises(ValueError, match="no keras.applications source"):
+        build_keras_model(get_entry("ViTB16"))
