@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Sequence
 
@@ -101,6 +102,111 @@ class Trials:
         return [t.get("loss") for t in self.trials]
 
 
+def _eval_trial(objective, i, params) -> dict:
+    """One trial -> result record; failures never kill the sweep."""
+    try:
+        out = objective(params)
+        loss = out["loss"] if isinstance(out, dict) else float(out)
+        extra = out if isinstance(out, dict) else {}
+        return {"tid": i, "params": params, "loss": float(loss),
+                "status": "ok", **{k: v for k, v in extra.items()
+                                   if k not in ("loss", "status")}}
+    except Exception as e:
+        logger.warning("trial %d failed: %s", i, e)
+        return {"tid": i, "params": params, "loss": None,
+                "status": "fail", "error": repr(e)}
+
+
+def _run_trials_processes(objective, candidates, parallelism) -> list[dict]:
+    """Each trial in a FRESH interpreter (own jax runtime/devices), at
+    most ``parallelism`` concurrent — the single-host analogue of
+    SparkTrials' executor-side evaluation."""
+    import subprocess
+    import sys
+    import tempfile
+    import time as _time
+
+    import cloudpickle
+
+    pending = list(enumerate(candidates))
+    running: dict = {}  # popen -> (tid, params, result_path)
+    results: list[dict] = []
+
+    with tempfile.TemporaryDirectory(prefix="sparkdl_hpo_") as workdir:
+        def launch(i, params):
+            payload = os.path.join(workdir, f"trial{i}.pkl")
+            result = os.path.join(workdir, f"trial{i}.out")
+            with open(payload, "wb") as f:
+                cloudpickle.dump(
+                    {"objective": objective, "params": params}, f)
+            p = subprocess.Popen(
+                [sys.executable, "-m", "sparkdl_tpu._trial_worker",
+                 payload, result],
+            )
+            running[p] = (i, params, result)
+
+        try:
+            while pending or running:
+                while pending and len(running) < max(1, parallelism):
+                    launch(*pending.pop(0))
+                done = [p for p in running if p.poll() is not None]
+                if not done:
+                    _time.sleep(0.05)
+                    continue
+                for p in done:
+                    i, params, rpath = running.pop(p)
+                    try:
+                        with open(rpath, "rb") as f:
+                            r = cloudpickle.load(f)
+                    except Exception as e:
+                        r = {"loss": None, "status": "fail",
+                             "error": f"worker died: exit "
+                                      f"{p.returncode} ({e!r})"}
+                    if r["status"] == "fail":
+                        logger.warning("trial %d failed: %s", i,
+                                       r.get("error"))
+                    results.append({"tid": i, "params": params, **r})
+        finally:
+            # never orphan worker interpreters if the sweep loop raises
+            for p in running:
+                if p.poll() is None:
+                    p.kill()
+            for p in running:
+                p.wait(timeout=10)
+    results.sort(key=lambda r: r["tid"])
+    return results
+
+
+def _run_trials_spark(objective, candidates, parallelism,
+                      spark=None) -> list[dict]:
+    """SparkTrials equivalent: one Spark task per trial, fanned over the
+    cluster's executors (the reference pairs Hyperopt's SparkTrials with
+    HorovodRunner this way — SURVEY.md 2.13). ``spark`` may be a
+    SparkSession or anything exposing ``sparkContext.parallelize``."""
+    sc = None
+    if spark is not None:
+        sc = getattr(spark, "sparkContext", spark)
+    else:
+        try:
+            from pyspark.sql import SparkSession
+
+            active = SparkSession.getActiveSession()
+            sc = active.sparkContext if active is not None else None
+        except Exception:
+            sc = None
+    if sc is None:
+        raise RuntimeError(
+            "trial_runner='spark' needs a SparkSession (pass spark=..., "
+            "or use 'processes' for single-host isolation)"
+        )
+    n_slices = max(1, min(parallelism, len(candidates)))
+    rdd = sc.parallelize(list(enumerate(candidates)), n_slices)
+    return sorted(
+        rdd.map(lambda ip: _eval_trial(objective, ip[0], ip[1])).collect(),
+        key=lambda r: r["tid"],
+    )
+
+
 def fmin(
     objective: Callable[[dict], float | dict],
     space: dict,
@@ -110,21 +216,43 @@ def fmin(
     parallelism: int = 1,
     trials: Trials | None = None,
     use_hyperopt: bool | None = None,
+    trial_runner: "str | Callable" = "threads",
+    spark=None,
 ) -> dict:
     """Minimise ``objective`` over ``space``; returns the best param dict.
 
     ``objective`` gets a concrete param dict and returns a float loss (or a
     dict with a ``loss`` key, hyperopt-style). With hyperopt installed (and
     ``use_hyperopt`` not False) delegates to ``hyperopt.fmin`` + TPE;
-    otherwise runs seeded random search, ``parallelism`` trials at a time
-    (threads — each trial typically blocks on device work or a TPURunner
-    job, so the GIL is not the limiter).
+    otherwise runs seeded random search with ``parallelism`` trials at a
+    time through ``trial_runner``:
+
+    - ``"threads"`` — driver threads (trials block on device work or a
+      TPURunner job, so the GIL is not the limiter);
+    - ``"processes"`` — one fresh interpreter per trial (own jax
+      runtime), at most ``parallelism`` concurrent;
+    - ``"spark"`` — one Spark task per trial over the cluster (the
+      SparkTrials pairing of SURVEY.md 2.13; pass ``spark=`` or have an
+      active session);
+    - a callable ``f(objective, candidates, parallelism) -> results``.
     """
+    if not callable(trial_runner) and trial_runner not in (
+            "threads", "processes", "spark"):
+        raise ValueError(
+            f"unknown trial_runner {trial_runner!r}: expected 'threads', "
+            "'processes', 'spark', or a callable"
+        )
     if use_hyperopt is None:
         use_hyperopt = _hyperopt is not None
     if use_hyperopt:
         if _hyperopt is None:
             raise RuntimeError("hyperopt requested but not installed")
+        if callable(trial_runner) or trial_runner != "threads":
+            logger.warning(
+                "hyperopt path evaluates trials serially in the driver; "
+                "trial_runner=%r ignored — pass use_hyperopt=False for "
+                "the distributed trial runners", trial_runner,
+            )
         if parallelism > 1:
             logger.warning(
                 "hyperopt path runs trials serially (TPE is sequential); "
@@ -168,24 +296,22 @@ def fmin(
     rng = np.random.default_rng(seed)
     candidates = [sample_space(space, rng) for _ in range(max_evals)]
 
-    def run_one(i_params):
-        i, params = i_params
-        try:
-            out = objective(params)
-            loss = out["loss"] if isinstance(out, dict) else float(out)
-            extra = out if isinstance(out, dict) else {}
-            return {"tid": i, "params": params, "loss": float(loss),
-                    "status": "ok", **{k: v for k, v in extra.items()
-                                       if k not in ("loss", "status")}}
-        except Exception as e:  # a failed trial shouldn't kill the sweep
-            logger.warning("trial %d failed: %s", i, e)
-            return {"tid": i, "params": params, "loss": None, "status": "fail",
-                    "error": repr(e)}
-
-    if parallelism <= 1:
-        results = [run_one(x) for x in enumerate(candidates)]
-    else:
-        with ThreadPoolExecutor(max_workers=parallelism) as pool:
-            results = list(pool.map(run_one, enumerate(candidates)))
+    if callable(trial_runner):
+        results = trial_runner(objective, candidates, parallelism)
+    elif trial_runner == "spark":
+        results = _run_trials_spark(objective, candidates, parallelism,
+                                    spark=spark)
+    elif trial_runner == "processes":
+        results = _run_trials_processes(objective, candidates, parallelism)
+    else:  # "threads" (validated above)
+        if parallelism <= 1:
+            results = [_eval_trial(objective, i, p)
+                       for i, p in enumerate(candidates)]
+        else:
+            with ThreadPoolExecutor(max_workers=parallelism) as pool:
+                results = list(pool.map(
+                    lambda ip: _eval_trial(objective, ip[0], ip[1]),
+                    enumerate(candidates),
+                ))
     trials.trials.extend(results)
     return dict(trials.best_trial["params"])

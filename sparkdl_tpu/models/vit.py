@@ -145,17 +145,21 @@ class ViTModel(nn.Module):
 
     ``features`` is the final-LayerNorm CLS token ([B, hidden]).
     Construction fields mirror ZooModule so the registry builds it like
-    any named model.
+    any named model. ``num_classes`` defaults to the config's (which
+    ``load_hf_vit`` sets from HF ``num_labels``, so converted classifier
+    heads apply without re-specifying it).
     """
 
     config: ViTConfig = ViTConfig()
-    num_classes: int = 1000
+    num_classes: "int | None" = None  # None -> config.num_classes
     include_top: bool = True
     dtype: Any = None  # overrides config.dtype when set
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         c = self.config
+        n_classes = (self.num_classes if self.num_classes is not None
+                     else c.num_classes)
         if self.dtype is not None and self.dtype != c.dtype:
             c = dataclasses.replace(c, dtype=self.dtype)
         p = c.patch_size
@@ -195,7 +199,7 @@ class ViTModel(nn.Module):
         features = h[:, 0].astype(jnp.float32)
         if not self.include_top:
             return features, None
-        logits = nn.Dense(self.num_classes, dtype=c.dtype,
+        logits = nn.Dense(n_classes, dtype=c.dtype,
                           param_dtype=jnp.float32, name="classifier")(
             h[:, 0])
         return features, jax.nn.softmax(logits.astype(jnp.float32))
@@ -228,6 +232,7 @@ def config_from_hf_vit(hf_config) -> ViTConfig:
         intermediate_size=hf_config.intermediate_size,
         layer_norm_eps=hf_config.layer_norm_eps,
         dropout=0.0,
+        num_classes=getattr(hf_config, "num_labels", None) or 1000,
     )
 
 

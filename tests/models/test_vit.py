@@ -99,7 +99,8 @@ def test_hf_vit_classifier_probs():
     )
     hf = transformers.ViTForImageClassification(hf_cfg).eval()
     cfg, variables = load_hf_vit(hf)
-    model = ViTModel(config=cfg, num_classes=7, include_top=True)
+    assert cfg.num_classes == 7  # picked up from HF num_labels
+    model = ViTModel(config=cfg, include_top=True)
 
     x = rng.standard_normal((2, 32, 32, 3)).astype(np.float32)
     with torch.no_grad():
