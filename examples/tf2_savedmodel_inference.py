@@ -1,0 +1,84 @@
+"""TF2 SavedModel ingestion: native TPU execution of a Keras export.
+
+A user hands the pipeline a TF2 SavedModel (the ``tf.saved_model.save``/
+Keras-export artifact — a function-call graph over a function library,
+NOT a flat TF1 frozen graph). ``TFInputGraph.fromSavedModelWithSignature``
+loads it through the TF2 loader, freezes+inlines the call tree, and the
+native GraphDef→JAX translator rebuilds it as jittable JAX ops — so it
+runs on TPU with no TF in the execution path (CPU-only TF wheels cannot
+emit TPU programs). ``TFTransformer`` then scores a DataFrame with it.
+
+The SavedModel is exported in a subprocess with the TF Keras backend
+(mirroring the usual situation: the artifact was produced elsewhere).
+
+Run: python examples/tf2_savedmodel_inference.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+_EXPORT = """
+import sys
+import numpy as np
+import tensorflow as tf
+
+d = sys.argv[1]
+tf.keras.utils.set_random_seed(0)
+inp = tf.keras.Input([8])
+h = tf.keras.layers.Dense(16, activation="relu")(inp)
+out = tf.keras.layers.Dense(4, activation="softmax")(h)
+m = tf.keras.Model(inp, out)
+
+@tf.function(input_signature=[tf.TensorSpec([None, 8], tf.float32)])
+def serve(x):
+    return {"probs": m(x)}
+
+tf.saved_model.save(m, d, signatures={"serving_default": serve})
+x = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+np.savez(d + "/oracle.npz", x=x, y=m(x).numpy())
+"""
+
+
+def main() -> None:
+    sm_dir = os.path.join(tempfile.mkdtemp(prefix="tf2sm_"), "model")
+    env = dict(os.environ, KERAS_BACKEND="tensorflow",
+               TF_CPP_MIN_LOG_LEVEL="2")
+    subprocess.run([sys.executable, "-c", _EXPORT, sm_dir], check=True,
+                   env=env, capture_output=True, text=True)
+    data = np.load(sm_dir + "/oracle.npz")
+    x, want = data["x"], data["y"]
+
+    from sparkdl_tpu import TFInputGraph, TFTransformer
+    from sparkdl_tpu.dataframe.local import LocalDataFrame
+    from sparkdl_tpu.graph.tf2jax import untranslatable_ops
+
+    tig = TFInputGraph.fromSavedModelWithSignature(sm_dir)
+    assert untranslatable_ops(tig.graph_def, tig.output_names) == [], (
+        "expected the frozen TF2 graph to be fully native-translatable"
+    )
+
+    df = LocalDataFrame.from_rows(
+        [{"id": i, "v": x[i].tolist()} for i in range(len(x))],
+        num_partitions=2,
+    )
+    tft = TFTransformer(
+        tfInputGraph=tig,
+        inputMapping={"v": "x"},          # column -> signature key
+        outputMapping={"probs": "probs"},  # signature key -> column
+    )
+    rows = tft.transform(df).collect()
+    got = np.asarray([r["probs"] for r in rows])
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+    print(f"TF2 SavedModel scored natively: {got.shape[0]} rows, "
+          f"max |Δ| vs the original Keras forward = "
+          f"{np.abs(got - want).max():.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -54,3 +54,8 @@ def test_distributed_resnet_training():
 def test_bert_finetune_hpo():
     out = _run("bert_finetune_hpo.py", "--evals", "2", "--epochs", "1")
     assert "best params" in out
+
+
+def test_tf2_savedmodel_inference():
+    out = _run("tf2_savedmodel_inference.py")
+    assert "scored natively" in out
