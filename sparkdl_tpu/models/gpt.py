@@ -376,6 +376,43 @@ def load_hf_gpt2(hf_model) -> "tuple[GPTConfig, dict]":
     return cfg, {"params": params}
 
 
+def sample_logits(
+    logits: jax.Array, key: jax.Array, *,
+    temperature: float, top_k: "int | None" = None,
+    top_p: "float | None" = None,
+) -> jax.Array:
+    """One sampling step over [B, V] logits, jit-safe.
+
+    temperature 0 = greedy (top_k/top_p ignored); otherwise temperature
+    scaling, then optional top-k truncation, then optional top-p
+    (nucleus) truncation — the standard serving controls, composable.
+    """
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        # HF-parity clamp: top_k beyond the vocab keeps everything
+        # (serving defaults like 50 must not crash tiny-vocab models)
+        top_k = min(top_k, logits.shape[-1])
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        # keep every token whose preceding cumulative mass is < top_p
+        # (the first token is always kept)
+        keep = csum - probs < top_p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+            keepdims=True,
+        )
+        logits = jnp.where(logits < cutoff, _NEG_INF, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
 def generate(
     model: GPTLMHeadModel,
     variables: Any,
@@ -383,13 +420,16 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
 ) -> jax.Array:
     """Autoregressive decode: prefill the prompt, then one lax.scan step
     per token (KV-cached, single jittable program — no Python loop).
 
-    temperature 0 = greedy; >0 = sampled (requires ``rng``).
+    temperature 0 = greedy; >0 = sampled (requires ``rng``), with
+    optional ``top_k`` / ``top_p`` (nucleus) truncation.
     Returns [B, prompt_len + max_new_tokens] token ids.
     """
     b, lp = prompt_ids.shape
@@ -410,13 +450,20 @@ def generate(
         )
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature>0) requires rng")
+    if temperature <= 0 and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p only apply when sampling (temperature > 0)"
+        )
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
     def sample(logits, key):
-        if temperature > 0:
-            return jax.random.categorical(key, logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
+        return sample_logits(logits, key, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
 
     cache = init_cache(model.config, b, max_len)
     logits, cache = model.apply(variables, prompt_ids, cache=cache)

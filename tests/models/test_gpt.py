@@ -127,6 +127,78 @@ def test_generate_greedy_matches_manual_argmax(tiny):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 
+def test_sample_logits_topk_topp():
+    """Truncation semantics on a hand-built distribution."""
+    from sparkdl_tpu.models.gpt import sample_logits
+
+    logits = jnp.log(jnp.asarray(
+        [[0.5, 0.25, 0.15, 0.06, 0.04]], jnp.float32))
+    keys = jax.random.split(jax.random.PRNGKey(0), 300)
+
+    # top_k=1 is greedy regardless of temperature
+    toks = jnp.stack([
+        sample_logits(logits, k, temperature=1.0, top_k=1) for k in keys[:20]
+    ])
+    assert set(np.asarray(toks).ravel()) == {0}
+
+    # top_k=2 only emits the two largest
+    toks = jnp.stack([
+        sample_logits(logits, k, temperature=1.0, top_k=2) for k in keys
+    ])
+    assert set(np.asarray(toks).ravel()) <= {0, 1}
+
+    # top_p=0.7: nucleus {0.5, 0.25} (preceding mass 0, 0.5 < 0.7; token 2
+    # has preceding mass 0.75 — excluded)
+    toks = jnp.stack([
+        sample_logits(logits, k, temperature=1.0, top_p=0.7) for k in keys
+    ])
+    assert set(np.asarray(toks).ravel()) <= {0, 1}
+
+    # top_k beyond the vocab clamps (HF parity: serving defaults like 50
+    # must not crash tiny-vocab models) == plain sampling per key
+    for k in keys[:5]:
+        np.testing.assert_array_equal(
+            np.asarray(sample_logits(logits, k, temperature=1.0,
+                                     top_k=50)),
+            np.asarray(sample_logits(logits, k, temperature=1.0)),
+        )
+    with pytest.raises(ValueError, match="top_k"):
+        sample_logits(logits, keys[0], temperature=1.0, top_k=0)
+
+    # top_p=1.0 keeps everything: identical to plain sampling per key
+    for k in keys[:10]:
+        np.testing.assert_array_equal(
+            np.asarray(sample_logits(logits, k, temperature=1.0,
+                                     top_p=1.0)),
+            np.asarray(sample_logits(logits, k, temperature=1.0)),
+        )
+
+
+def test_generate_topk_topp_paths(tiny):
+    cfg, model, params, ids = tiny
+    prompt = ids[:, :4]
+    out = jax.jit(lambda p, x: generate(
+        model, p, x, 5, temperature=0.8, top_k=3,
+        rng=jax.random.PRNGKey(1),
+    ))(params, prompt)
+    assert out.shape == (2, 9)
+    out2 = jax.jit(lambda p, x: generate(
+        model, p, x, 5, temperature=0.8, top_p=0.9,
+        rng=jax.random.PRNGKey(1),
+    ))(params, prompt)
+    assert out2.shape == (2, 9)
+
+    key = jax.random.PRNGKey(0)
+    with pytest.raises(ValueError, match="temperature"):
+        generate(model, params, prompt, 2, top_k=3)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 2, temperature=1.0, top_p=1.5,
+                 rng=key)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, 2, temperature=1.0, top_k=0,
+                 rng=key)
+
+
 def test_generate_sampling_runs_and_differs_by_rng(tiny):
     cfg, model, params, ids = tiny
     prompt = ids[:, :3]
