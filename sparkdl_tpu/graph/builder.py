@@ -14,10 +14,13 @@ session ([U: tensorframes], SURVEY.md 2.15).
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Callable, Sequence
 
 from sparkdl_tpu.graph import utils as tfx
 from sparkdl_tpu.graph._tf import require_tf
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -165,6 +168,16 @@ class GraphFunction:
                 # function to call_tf
                 fallback = make_call_tf()
                 out = fallback(*arrays)
+                # log at the latch point only: a user-input error raises
+                # from both paths (propagating above), so reaching here
+                # means the translator genuinely lost a graph that TF can
+                # run — keep that observable instead of masking it.
+                logger.warning(
+                    "native graph translation failed at run time; the "
+                    "call_tf fallback succeeded and is latched for this "
+                    "graph — fix the translator to regain the native "
+                    "path", exc_info=True,
+                )
                 chosen.append(fallback)
                 return out
 

@@ -222,9 +222,13 @@ def fmin(
     """Minimise ``objective`` over ``space``; returns the best param dict.
 
     ``objective`` gets a concrete param dict and returns a float loss (or a
-    dict with a ``loss`` key, hyperopt-style). With hyperopt installed (and
-    ``use_hyperopt`` not False) delegates to ``hyperopt.fmin`` + TPE;
-    otherwise runs seeded random search with ``parallelism`` trials at a
+    dict with a ``loss`` key, hyperopt-style). With hyperopt installed and
+    a serial configuration (default ``trial_runner`` "threads" and
+    ``parallelism=1``) delegates to ``hyperopt.fmin`` + TPE — an explicit
+    distributed request (``parallelism>1`` or a 'processes'/'spark'/
+    callable ``trial_runner``) opts out, since TPE evaluates serially in
+    the driver (pass ``use_hyperopt=True`` to force the TPE path anyway).
+    Otherwise runs seeded random search with ``parallelism`` trials at a
     time through ``trial_runner``:
 
     - ``"threads"`` — driver threads (trials block on device work or a
@@ -243,7 +247,15 @@ def fmin(
             "'processes', 'spark', or a callable"
         )
     if use_hyperopt is None:
-        use_hyperopt = _hyperopt is not None
+        # hyperopt evaluates trials serially in the driver, so any explicit
+        # signal of distributed intent — a non-default trial_runner OR
+        # parallelism>1 — opts out of the auto-upgrade; only the default
+        # serial configuration silently takes the TPE path.
+        use_hyperopt = (
+            _hyperopt is not None
+            and trial_runner == "threads"
+            and parallelism == 1
+        )
     if use_hyperopt:
         if _hyperopt is None:
             raise RuntimeError("hyperopt requested but not installed")

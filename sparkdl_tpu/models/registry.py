@@ -126,7 +126,9 @@ def build_flax_model(name: str, weights: "str | None" = "imagenet",
     """Return (module, variables) for a named model.
 
     ``weights`` may be 'imagenet', a path to a Keras .h5/.keras file, or
-    None for random init.
+    None / 'random' for random init ('random' exists so Spark-ML Param
+    plumbing — where None means "unset, use the default" — can still
+    request random init explicitly).
     """
     import jax
     import jax.numpy as jnp
@@ -139,6 +141,8 @@ def build_flax_model(name: str, weights: "str | None" = "imagenet",
     )
 
     entry = get_entry(name)
+    if weights == "random":
+        weights = None
     if dtype is None:
         dtype = jnp.float32
     ktop = include_top or entry.features_need_top
@@ -149,22 +153,17 @@ def build_flax_model(name: str, weights: "str | None" = "imagenet",
         # HF-family pretrained weights load through the family's
         # load_hf_* converter (e.g. models.vit.load_hf_vit on a
         # transformers model instance) — the 'imagenet' shortcut is a
-        # keras.applications concept. Only that DEFAULT degrades to
-        # random init (mirroring the zero-egress fallback); an explicit
-        # weights path must fail loudly, never silently random-init.
-        if weights != "imagenet":
-            raise ValueError(
-                f"model {name} sources pretrained weights from HF — "
-                f"weights={weights!r} has no keras.applications loader. "
-                "Convert a transformers model via its load_hf_* "
-                "converter (e.g. models.vit.load_hf_vit) instead."
-            )
-        logger.warning(
-            "model %s sources pretrained weights from HF (use "
-            "models.vit.load_hf_vit on a transformers model); "
-            "weights='imagenet' ignored — using random init", name,
+        # keras.applications concept with no loader here. ANY non-None
+        # weights (including the 'imagenet' default) fails loudly:
+        # silently degrading to random init would hand back garbage
+        # features for a model listed in SUPPORTED_MODELS.
+        raise ValueError(
+            f"model {name} sources pretrained weights from HF — "
+            f"weights={weights!r} has no keras.applications loader. "
+            "Pass weights='random' (or None) explicitly for random "
+            "init, or convert a transformers model via the family's "
+            "load_hf_* converter (e.g. models.vit.load_hf_vit)."
         )
-        weights = None
     if weights is None:
         h, w = entry.input_size
         variables = module.init(

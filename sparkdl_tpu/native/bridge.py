@@ -310,8 +310,14 @@ class DeviceFeeder:
                         if batch[k].nbytes > seg[k]:
                             raise ValueError(
                                 f"feed {k!r} of {batch[k].nbytes}B exceeds "
-                                f"its slot segment {seg[k]}B (set "
-                                "max_batch_bytes)"
+                                f"its slot segment {seg[k]}B. Segments are "
+                                "fixed up front (from max_batch_bytes, "
+                                "else the FIRST batch's bytes), so no "
+                                "later batch may be larger — size "
+                                "max_batch_bytes for the largest batch, "
+                                "or for variable-sized rows use the "
+                                "Python feed path (ragged_rows=True on "
+                                "BatchedRunner feeds)."
                             )
                         total += batch[k].nbytes
                     idx = None

@@ -134,7 +134,8 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol, HasBatchSiz
     )
     weights = Param(
         None, "weights",
-        "'imagenet', a local Keras .h5/.keras file, or None for random init",
+        "'imagenet', a local Keras .h5/.keras file, or 'random' for "
+        "random init (None in the constructor means unset -> default)",
     )
 
     _include_top: bool = True

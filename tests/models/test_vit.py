@@ -114,8 +114,8 @@ def test_hf_vit_classifier_probs():
 
 def test_registry_and_featurizer_route():
     """DeepImageFeaturizer(modelName='ViTB16') drives the ViT like any
-    named CNN (random init — zero-egress; weight fidelity is pinned by
-    the HF oracle above)."""
+    named CNN (explicit weights=None — zero-egress; weight fidelity is
+    pinned by the HF oracle above)."""
     from sparkdl_tpu.dataframe.local import LocalDataFrame
     from sparkdl_tpu.image.imageIO import imageArrayToStruct
     from sparkdl_tpu.models.registry import build_flax_model, get_entry
@@ -130,9 +130,19 @@ def test_registry_and_featurizer_route():
         for _ in range(3)
     ]
     df = LocalDataFrame([rows])
+
+    # the featurizer default weights='imagenet' has no HF loader: it must
+    # fail loudly (never silently random-init garbage features)
     feat = DeepImageFeaturizer(
         modelName="ViTB16", inputCol="image", outputCol="features",
         batchSize=2,
+    )
+    with pytest.raises(ValueError, match="weights='random'"):
+        feat.transform(df).collect()
+
+    feat = DeepImageFeaturizer(
+        modelName="ViTB16", inputCol="image", outputCol="features",
+        batchSize=2, weights="random",
     )
     got = feat.transform(df).collect()
     assert len(got) == 3 and len(got[0]["features"]) == 768
