@@ -86,7 +86,30 @@ def main() -> None:
     rng = np.random.default_rng(0)
     shape = (batch, size, size, 3) if scan_k == 1 else (
         scan_k, batch, size, size, 3)
-    x = jax.device_put(rng.integers(0, 256, shape, dtype=np.uint8))
+    x = rng.integers(0, 256, shape, dtype=np.uint8)
+
+    # Local multi-chip DP (SURVEY.md 2.11a / transformers/_inference.py):
+    # BENCH_DP_DEVICES=n shards the batch dim over an n-device dp mesh —
+    # the committed input sharding makes jit compile the forward SPMD,
+    # exactly how BatchedRunner feeds a multi-chip host. Default 1 keeps
+    # the single-chip driver contract unchanged.
+    dp = int(os.environ.get("BENCH_DP_DEVICES", "1"))
+    if dp > 1:
+        from sparkdl_tpu.runtime.mesh import data_parallel_mesh
+
+        if dp > len(jax.devices()):
+            raise SystemExit(
+                f"BENCH_DP_DEVICES={dp} but only {len(jax.devices())} "
+                "devices available"
+            )
+        if batch % dp:
+            raise SystemExit(f"BENCH_BATCH {batch} not divisible by {dp}")
+        mesh = data_parallel_mesh(jax.devices()[:dp])
+        spec = (jax.sharding.PartitionSpec("dp") if scan_k == 1
+                else jax.sharding.PartitionSpec(None, "dp"))
+        x = jax.device_put(x, jax.sharding.NamedSharding(mesh, spec))
+    else:
+        x = jax.device_put(x)
 
     # warmup / compile (scalar read also drains any queued work — the
     # block_until_ready readiness signal can fire early on relayed backends)
@@ -104,16 +127,19 @@ def main() -> None:
 
     images_per_sec = scan_k * batch * steps / dt
     target = 10_000.0
+    # dp>1 reports AGGREGATE throughput; vs_baseline stays per-chip so the
+    # number remains comparable to the single-chip target.
     print(
         json.dumps(
             {
-                "metric": f"InceptionV3 featurization images/sec/chip "
-                          f"({platform}, {size}px, batch {batch}"
+                "metric": f"InceptionV3 featurization images/sec"
+                          + ("/chip " if dp == 1 else f" over {dp} devices ")
+                          + f"({platform}, {size}px, batch {batch}"
                           + (f", scan {scan_k}" if scan_k > 1 else "")
                           + ")",
                 "value": round(images_per_sec, 1),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(images_per_sec / target, 4),
+                "unit": "images/sec" + ("/chip" if dp == 1 else ""),
+                "vs_baseline": round(images_per_sec / dp / target, 4),
             }
         )
     )

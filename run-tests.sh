@@ -17,6 +17,15 @@ rec = json.loads(line)
 assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
 print("bench.py contract OK")
 '
+# Local multi-chip DP hook: same contract, batch sharded over 8 fake chips.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  BENCH_STEPS=2 BENCH_BATCH=8 BENCH_DP_DEVICES=8 python bench.py | tail -1 | python -c '
+import json, sys
+rec = json.loads(sys.stdin.readline())
+assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
+assert "over 8 devices" in rec["metric"], rec
+print("bench.py dp contract OK")
+'
 # Secondary benches keep the same one-JSON-line contract (values are
 # CPU-smoke only; the real numbers come from the chip — PERF.md).
 for b in bench_tf_ingest.py bench_hostfed.py; do
