@@ -55,9 +55,12 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     dropout: float = 0.0
     #: "full" | "flash" (Pallas fused kernels) | "ring" (sp-sharded).
-    #: "flash" covers BOTH the uncached forward (ops/flash_attention) and
-    #: single-token KV-cached decode (ops/flash_decode); cached PREFILL
-    #: (L>1 with cache) still takes the dense masked path.
+    #: "flash" covers the uncached forward (ops/flash_attention),
+    #: single-token KV-cached decode (ops/flash_decode), AND cached
+    #: prefill with a concrete idx (flash over the written prefix with a
+    #: static causal q-offset — O(idx+L) keys, not O(max_len)); only a
+    #: traced-idx prefill (jitted streaming callers) falls back to the
+    #: dense masked path.
     attn_impl: str = "full"
     sp_axis: str = "sp"
     #: 0 = dense MLPs; >0 = MoE with this many experts
@@ -111,7 +114,8 @@ class GPTAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, cache: Optional[dict], train: bool,
-                 positions: Optional[jax.Array] = None):
+                 positions: Optional[jax.Array] = None,
+                 attention_mask: Optional[jax.Array] = None):
         c = self.config
         h, nh = c.hidden_size, c.num_heads
         hd = h // nh
@@ -157,22 +161,56 @@ class GPTAttention(nn.Module):
                 # the cache once, no [B,H,1,L] scores in HBM
                 from sparkdl_tpu.ops.flash_decode import flash_decode
 
-                ctx = flash_decode(q, ck, cv, idx)
+                start = None
+                if attention_mask is not None:
+                    # left-padded rows: first valid buffer column per row
+                    start = jnp.argmax(
+                        attention_mask.astype(jnp.int32), axis=1
+                    )
+                ctx = flash_decode(q, ck, cv, idx, start=start)
+            elif (c.attn_impl == "flash" and l > 1
+                  and not isinstance(idx, jax.core.Tracer)):
+                # cached PREFILL with concrete idx (generate()'s eager
+                # prefill is always idx=0): flash over the WRITTEN prefix
+                # only — O(idx+L) keys per query instead of the dense
+                # path's O(max_len) over every unwritten buffer column.
+                # Queries sit at global positions [idx, idx+L), hence the
+                # static q_offset in the kernel's causal mask.
+                from sparkdl_tpu.ops.flash_attention import flash_attention
+
+                end = int(idx) + l
+                kv_mask = (attention_mask[:, :end]
+                           if attention_mask is not None else None)
+                ctx = flash_attention(
+                    q, ck[:, :end], cv[:, :end], kv_mask,
+                    causal=True, q_offset=int(idx),
+                )
             else:
                 # prefill (L>1) and non-flash decode: dense masked path
                 max_len = ck.shape[1]
                 q_pos = idx + jnp.arange(l)  # [L]
                 k_pos = jnp.arange(max_len)  # [max_len]
-                mask = k_pos[None, :] <= q_pos[:, None]  # causal+unwritten
+                mask = (k_pos[None, :] <= q_pos[:, None])[None, None]
+                if attention_mask is not None:
+                    # [B, max_len] buffer-column validity (pad columns of
+                    # left-padded ragged prompts are False forever)
+                    mask = mask & attention_mask[:, None, None, :]
                 s = jnp.einsum(
                     "bqhd,bkhd->bhqk", q, ck,
                     preferred_element_type=jnp.float32,
                 ) / math.sqrt(hd)
-                s = jnp.where(mask[None, None], s, _NEG_INF)
+                s = jnp.where(mask, s, _NEG_INF)
                 p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
                 ctx = jnp.einsum("bhqk,bkhd->bqhd", p, cv)
         else:
             new_entry = None
+            if attention_mask is not None and c.attn_impl != "full":
+                raise ValueError(
+                    "attention_mask on the uncached forward requires "
+                    f"attn_impl='full' (got {c.attn_impl!r}); the flash/"
+                    "ring kernels take ragged batches only through the "
+                    "KV-cached generate() path"
+                )
             if c.attn_impl == "flash":
                 from sparkdl_tpu.ops.flash_attention import flash_attention
 
@@ -186,8 +224,10 @@ class GPTAttention(nn.Module):
                     "bqhd,bkhd->bhqk", q, k,
                     preferred_element_type=jnp.float32,
                 ) / math.sqrt(hd)
-                causal = jnp.tril(jnp.ones((l, l), bool))
-                s = jnp.where(causal[None, None], s, _NEG_INF)
+                causal = jnp.tril(jnp.ones((l, l), bool))[None, None]
+                if attention_mask is not None:
+                    causal = causal & attention_mask[:, None, None, :]
+                s = jnp.where(causal, s, _NEG_INF)
                 p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
                 p = nn.Dropout(c.dropout, deterministic=not train)(p)
                 ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v)
@@ -204,12 +244,14 @@ class GPTBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, cache: Optional[dict], train: bool,
-                 positions: Optional[jax.Array] = None):
+                 positions: Optional[jax.Array] = None,
+                 attention_mask: Optional[jax.Array] = None):
         c = self.config
         a, new_entry = GPTAttention(c, self.layer_idx, name="attn")(
             nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
                          name="ln_1")(x),
             cache=cache, train=train, positions=positions,
+            attention_mask=attention_mask,
         )
         x = x + nn.Dropout(c.dropout, deterministic=not train)(a)
 
@@ -246,6 +288,13 @@ class GPTLMHeadModel(nn.Module):
     REQUIRED under ``attn_impl='ring'`` (sequence sharded on ``sp``): each
     shard must pass its global positions, not 0..L/sp-1 — the ring kernel
     offsets its causal mask globally, and RoPE must agree with it.
+
+    ``attention_mask``: optional key-validity mask excluding positions
+    from every attention softmax (False = masked). Shape [B, L] (over
+    this call's keys) on the uncached forward; [B, max_len] (over BUFFER
+    columns) on the cached path, where pad columns of left-padded ragged
+    prompts stay False for the whole generation. :func:`generate` builds
+    both from its ``attention_mask`` argument.
     """
 
     config: GPTConfig
@@ -253,7 +302,8 @@ class GPTLMHeadModel(nn.Module):
     @nn.compact
     def __call__(self, input_ids, *, cache: Optional[dict] = None,
                  train: bool = False,
-                 positions: Optional[jax.Array] = None):
+                 positions: Optional[jax.Array] = None,
+                 attention_mask: Optional[jax.Array] = None):
         c = self.config
         wte = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
                        name="wte")
@@ -271,7 +321,8 @@ class GPTLMHeadModel(nn.Module):
         new_ks, new_vs = [], []
         for i in range(c.num_layers):
             x, entry = GPTBlock(c, i, name=f"h_{i}")(
-                x, cache=cache, train=train, positions=positions
+                x, cache=cache, train=train, positions=positions,
+                attention_mask=attention_mask,
             )
             if entry is not None:
                 new_ks.append(entry[0])
@@ -424,6 +475,7 @@ def generate(
     top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
+    attention_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Autoregressive decode: prefill the prompt, then one lax.scan step
     per token (KV-cached, single jittable program — no Python loop).
@@ -431,6 +483,18 @@ def generate(
     temperature 0 = greedy; >0 = sampled (requires ``rng``), with
     optional ``top_k`` / ``top_p`` (nucleus) truncation.
     Returns [B, prompt_len + max_new_tokens] token ids.
+
+    Ragged batches: ``attention_mask`` ([B, prompt_len], 1 = real token)
+    decodes unequal-length prompts together. Prompts must be LEFT-padded
+    (the serving convention: every row's last prompt token sits in the
+    final column, so one logits column feeds sampling for all rows). Pad
+    columns are excluded from every attention softmax, and per-row RoPE/
+    learned positions count real tokens only — under GREEDY decoding
+    (temperature=0) row b of the output equals the unbatched ``generate``
+    of row b's unpadded prompt (oracle: tests/models/test_gpt_ragged.py);
+    sampled runs draw per-step noise shaped by the whole batch, so
+    sampled rows match only in distribution. Output rows keep their left
+    pads: ``[pads, prompt, generated]``.
     """
     b, lp = prompt_ids.shape
     if max_len is None:
@@ -465,14 +529,49 @@ def generate(
         return sample_logits(logits, key, temperature=temperature,
                              top_k=top_k, top_p=top_p)
 
+    positions = key_valid = pad_len = None
+    if attention_mask is not None:
+        if attention_mask.shape != (b, lp):
+            raise ValueError(
+                f"attention_mask shape {attention_mask.shape} != prompt "
+                f"shape {(b, lp)}"
+            )
+        mask = jnp.asarray(attention_mask).astype(bool)
+        # left-padded = rows non-decreasing (0...0 1...1), ≥1 real token.
+        # Value checks need concrete data — inside a jitted caller the
+        # mask is a tracer and the contract is the caller's to honor.
+        if not isinstance(mask, jax.core.Tracer):
+            if not bool(jnp.all(mask[:, 1:] >= mask[:, :-1])):
+                raise ValueError(
+                    "attention_mask must be left-padded (each row "
+                    "0...01...1); right-padded prompts cannot share a "
+                    "sampling column"
+                )
+            if not bool(jnp.all(mask[:, -1])):
+                raise ValueError("every row needs at least one real token")
+        pad_len = lp - mask.sum(axis=1)  # [B]
+        # logical positions: pads clamp to 0 (masked out of attention)
+        positions = jnp.clip(jnp.cumsum(mask, axis=1) - 1, 0)
+        # buffer-column validity for the WHOLE generation: pad columns
+        # stay False; every generated column is real
+        key_valid = jnp.concatenate(
+            [mask, jnp.ones((b, max_len - lp), bool)], axis=1
+        )
+
     cache = init_cache(model.config, b, max_len)
-    logits, cache = model.apply(variables, prompt_ids, cache=cache)
+    logits, cache = model.apply(variables, prompt_ids, cache=cache,
+                                positions=positions,
+                                attention_mask=key_valid)
     rng, key = jax.random.split(rng)
     tok = sample(logits[:, -1], key)
 
     def step(carry, _):
         cache, tok, rng = carry
-        logits, cache = model.apply(variables, tok[:, None], cache=cache)
+        pos = (None if pad_len is None
+               else (cache["idx"] - pad_len)[:, None])
+        logits, cache = model.apply(variables, tok[:, None], cache=cache,
+                                    positions=pos,
+                                    attention_mask=key_valid)
         rng, key = jax.random.split(rng)
         nxt = sample(logits[:, -1], key)
         return (cache, nxt, rng), tok

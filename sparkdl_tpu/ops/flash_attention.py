@@ -50,6 +50,9 @@ class _Config:
     block_q: int
     block_k: int
     interpret: bool
+    #: global position of query row 0 (cached prefill: queries sit at
+    #: [q_offset, q_offset+Lq) against keys at [0, Lk))
+    q_offset: int = 0
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -62,8 +65,9 @@ def _vmem(shape, dtype):
     return pltpu.VMEM(shape, dtype)
 
 
-def _causal_mask(s, qi, ki, bq, bk):
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+def _causal_mask(s, qi, ki, bq, bk, q_offset=0):
+    q_pos = (q_offset + qi * bq
+             + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
     k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     return jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
@@ -90,7 +94,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
     # Causal: skip K blocks strictly above the diagonal band.
     run = True
     if cfg.causal:
-        run = ki * bk <= qi * bq + bq - 1
+        run = ki * bk <= cfg.q_offset + qi * bq + bq - 1
 
     @pl.when(run)
     def _attend():
@@ -105,7 +109,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         ) * cfg.scale  # [bq, bk] f32
         s = jnp.where(mask_ref[0] != 0, s, _NEG_INF)  # [1, bk] broadcast
         if cfg.causal:
-            s = _causal_mask(s, qi, ki, bq, bk)
+            s = _causal_mask(s, qi, ki, bq, bk, cfg.q_offset)
 
         m_prev = m_scr[:]  # [bq, LANES] (all lanes equal)
         l_prev = l_scr[:]
@@ -177,7 +181,8 @@ def _recompute_p(q_ref, k_ref, mask_ref, lse_ref, qi, ki, cfg):
     ) * cfg.scale
     s = jnp.where(mask_ref[0] != 0, s, _NEG_INF)
     if cfg.causal:
-        s = _causal_mask(s, qi, ki, cfg.block_q, cfg.block_k)
+        s = _causal_mask(s, qi, ki, cfg.block_q, cfg.block_k,
+                         cfg.q_offset)
     return jnp.exp(s - lse_ref[0][:, :1])
 
 
@@ -193,7 +198,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if cfg.causal:
-        run = ki * cfg.block_k <= qi * cfg.block_q + cfg.block_q - 1
+        run = (ki * cfg.block_k
+               <= cfg.q_offset + qi * cfg.block_q + cfg.block_q - 1)
 
     @pl.when(run)
     def _accum():
@@ -231,7 +237,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if cfg.causal:
-        run = qi * cfg.block_q + cfg.block_q - 1 >= ki * cfg.block_k
+        run = (cfg.q_offset + qi * cfg.block_q + cfg.block_q - 1
+               >= ki * cfg.block_k)
 
     @pl.when(run)
     def _accum():
@@ -346,6 +353,7 @@ def flash_attention(
     scale: float | None = None,
     block_q: int = 512,
     block_k: int = 512,
+    q_offset: int = 0,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Fused flash attention over [B, L, H, D] tensors.
@@ -353,6 +361,9 @@ def flash_attention(
     kv_mask: optional [B, Lk] bool — False key positions (padding) are
     excluded. interpret=None auto-selects Pallas interpreter mode off-TPU.
     Differentiable in q/k/v (blockwise-recomputed backward kernels).
+    q_offset (static): global position of query row 0 for the causal
+    mask — cached prefill places L queries at [q_offset, q_offset+L)
+    against Lk >= L keys at [0, Lk).
 
     Block sizes default to 512: on real hardware a (bq, bk) program is
     ~bq*bk*d*4 FLOPs against ~microsecond-scale per-program overhead, so
@@ -395,7 +406,8 @@ def flash_attention(
         b * h, 1, lk_p)
 
     cfg = _Config(scale=float(scale), causal=bool(causal),
-                  block_q=bq, block_k=bk, interpret=bool(interpret))
+                  block_q=bq, block_k=bk, interpret=bool(interpret),
+                  q_offset=int(q_offset))
     o = _flash(cfg, qf, kf, vf, mask)
     o = o.reshape(b, h, lq_p, d_p).transpose(0, 2, 1, 3)
     return o[:, :lq, :, :d]

@@ -34,8 +34,8 @@ from sparkdl_tpu.ops._pallas import smem_space as _smem_space
 from sparkdl_tpu.ops._pallas import vmem as _vmem
 
 
-def _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref,
-            acc_scr, m_scr, l_scr, *, scale: float, bk: int):
+def _kernel(idx_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
+            acc_scr, m_scr, l_scr, *, scale: float, bk: int, h: int):
     ki = pl.program_id(1)
     nk = pl.num_programs(1)
 
@@ -45,8 +45,12 @@ def _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref,
         m_scr[0, 0] = _NEG_INF
         l_scr[0, 0] = 0.0
 
-    # positions strictly after idx are unwritten; skip blocks past it
-    live = ki * bk <= idx_ref[0]
+    # this row's first valid position (left-padded prompts): grid dim 0 is
+    # b*h, so the batch row is i // h
+    start = start_ref[pl.program_id(0) // h]
+    # positions strictly after idx are unwritten, before start are padding;
+    # skip blocks entirely outside [start, idx]
+    live = (ki * bk <= idx_ref[0]) & (ki * bk + bk > start)
 
     @pl.when(live)
     def _attend():
@@ -59,7 +63,7 @@ def _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref,
         pos = ki * bk + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 0
         )
-        s = jnp.where(pos <= idx_ref[0], s, _NEG_INF)
+        s = jnp.where((pos <= idx_ref[0]) & (pos >= start), s, _NEG_INF)
 
         m_prev = m_scr[0, 0]
         m_cur = jnp.max(s)
@@ -78,14 +82,16 @@ def _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_scr[:] / l_scr[0, 0]).astype(o_ref.dtype)
 
 
-def flash_decode(q, ck, cv, idx, *, block_k: int = 512,
+def flash_decode(q, ck, cv, idx, *, start=None, block_k: int = 512,
                  interpret: "bool | None" = None):
     """One decode step of cached attention.
 
     q: [B, 1, H, D] (this step's query); ck/cv: [B, L, H, D] cache
     buffers with positions ``<= idx`` written (idx = this query's
-    position, scalar int32). Returns ctx [B, 1, H, D] ==
-    ``softmax(q·K[:idx+1]ᵀ/√D)·V[:idx+1]``.
+    position, scalar int32). ``start`` ([B] int32, default 0) is each
+    row's first VALID cache position — left-padded ragged prompts mask
+    columns ``< start[b]`` out of the softmax. Returns ctx [B, 1, H, D]
+    == ``softmax(q·K[start:idx+1]ᵀ/√D)·V[start:idx+1]``.
     """
     if interpret is None:
         from sparkdl_tpu.ops._pallas import auto_interpret
@@ -109,11 +115,16 @@ def flash_decode(q, ck, cv, idx, *, block_k: int = 512,
     kf = ck.transpose(0, 2, 1, 3).reshape(b * h, lmax, d)
     vf = cv.transpose(0, 2, 1, 3).reshape(b * h, lmax, d)
     idx_arr = jnp.asarray(idx, jnp.int32).reshape(1)
+    if start is None:
+        start_arr = jnp.zeros((b,), jnp.int32)
+    else:
+        start_arr = jnp.asarray(start, jnp.int32).reshape(b)
 
     out = pl.pallas_call(
-        functools.partial(_kernel, scale=1.0 / math.sqrt(d), bk=bk),
+        functools.partial(_kernel, scale=1.0 / math.sqrt(d), bk=bk, h=h),
         grid=(b * h, lmax // bk),
         in_specs=[
+            pl.BlockSpec(memory_space=_smem_space()),
             pl.BlockSpec(memory_space=_smem_space()),
             pl.BlockSpec((1, 1, d), lambda i, ki: (i, 0, 0)),
             pl.BlockSpec((1, bk, d), lambda i, ki: (i, ki, 0)),
@@ -127,17 +138,21 @@ def flash_decode(q, ck, cv, idx, *, block_k: int = 512,
             _smem((1, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(idx_arr, qf, kf, vf)
+    )(idx_arr, start_arr, qf, kf, vf)
     return out.reshape(b, h, d).reshape(b, 1, h, d)
 
 
-def reference_decode(q, ck, cv, idx):
+def reference_decode(q, ck, cv, idx, start=None):
     """Dense oracle (the pre-kernel cached path's math, single query)."""
     b, _, h, d = q.shape
     lmax = ck.shape[1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
                    preferred_element_type=jnp.float32) / math.sqrt(d)
     mask = jnp.arange(lmax)[None, None, None, :] <= idx
+    if start is not None:
+        mask = mask & (
+            jnp.arange(lmax)[None, :] >= jnp.asarray(start)[:, None]
+        )[:, None, None, :]
     s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, cv)

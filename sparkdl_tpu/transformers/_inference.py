@@ -89,8 +89,15 @@ class BatchedRunner:
             else:
                 mesh = data_parallel_mesh(jax.local_devices()[:n_use])
                 self._sharding = batch_sharding(mesh)
+                # round the chunk size DOWN to a device multiple (never
+                # above the caller's memory ask): full batches then hit
+                # their bucket exactly instead of paying pad rows forever
+                self.batch_size = max(
+                    n_use, self.batch_size // n_use * n_use
+                )
                 self._buckets = tuple(sorted({
-                    -(-b // n_use) * n_use for b in self._buckets
+                    -(-b // n_use) * n_use
+                    for b in default_buckets(self.batch_size)
                 }))
 
     def run(self, rows: Iterator[dict[str, np.ndarray]]) -> Iterator[np.ndarray]:

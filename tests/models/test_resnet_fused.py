@@ -18,6 +18,10 @@ from sparkdl_tpu.models.resnet_fused import resnet50_fused_apply
 
 rng = np.random.default_rng(5)
 
+# whole-module fixture builds + runs full ResNet50 twice per test; the
+# fused path stays covered in the full lane (run-tests.sh --full)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def small_setup():

@@ -112,6 +112,7 @@ def test_hf_vit_classifier_probs():
                                atol=1e-4, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_registry_and_featurizer_route():
     """DeepImageFeaturizer(modelName='ViTB16') drives the ViT like any
     named CNN (explicit weights=None — zero-egress; weight fidelity is

@@ -47,6 +47,7 @@ def rng_img():
 class TestOracleFeatures:
     """include_top=False + pooling='avg' against our features output."""
 
+    @pytest.mark.slow
     @pytest.mark.parametrize(
         "name,size",
         [("ResNet50", 96), ("InceptionV3", 128), ("Xception", 128)],

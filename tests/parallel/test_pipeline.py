@@ -44,6 +44,7 @@ def test_pipeline_matches_sequential(pp_mesh):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_differentiable(pp_mesh):
     rng = np.random.default_rng(1)
     d = 4

@@ -38,6 +38,7 @@ def test_ring_matches_full(sp_mesh, causal):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_grads_match_full(sp_mesh):
     rng = np.random.default_rng(1)
     b, l, h, d = 2, 16, 2, 4

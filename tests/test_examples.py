@@ -24,11 +24,13 @@ def _run(script: str, *args: str) -> str:
     return out.stdout
 
 
+@pytest.mark.slow
 def test_transfer_learning_flowers():
     out = _run("transfer_learning_flowers.py", "--steps", "50")
     assert "train accuracy" in out
 
 
+@pytest.mark.slow
 def test_keras_tabular_inference():
     out = _run("keras_tabular_inference.py")
     assert "matches model.predict" in out
@@ -39,6 +41,7 @@ def test_sql_udf_scoring():
     assert "udf 'score_image'" in out
 
 
+@pytest.mark.slow
 def test_gpt_generation():
     out = _run("gpt_generation.py", "--steps", "25")
     assert "copy-task fidelity" in out
@@ -56,6 +59,7 @@ def test_bert_finetune_hpo():
     assert "best params" in out
 
 
+@pytest.mark.slow
 def test_tf2_savedmodel_inference():
     out = _run("tf2_savedmodel_inference.py")
     assert "scored natively" in out

@@ -57,6 +57,7 @@ def test_trials_no_success_raises():
         _ = t.best_trial
 
 
+@pytest.mark.slow
 def test_process_trials_isolated_interpreters():
     """trial_runner='processes': each trial evaluates in its own fresh
     interpreter (SparkTrials' executor-side isolation, single-host form),

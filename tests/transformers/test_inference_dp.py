@@ -55,8 +55,11 @@ def test_dp_output_equals_single_device():
 def test_dp_buckets_divide_device_count():
     runner = BatchedRunner(apply_fn, batch_size=50)
     n = runner._sharding.num_devices
+    # chunk size rounds DOWN to a device multiple (never above the
+    # caller's memory ask) so full batches hit their bucket exactly
+    assert runner.batch_size == 48
     assert all(b % n == 0 for b in runner._buckets)
-    assert max(runner._buckets) >= 50
+    assert max(runner._buckets) == 48
     # tiny batch sizes shrink the mesh rather than over-padding
     small = BatchedRunner(apply_fn, batch_size=2)
     assert small._sharding.num_devices == 2
@@ -79,13 +82,12 @@ def test_dp_true_rejects_unshardable_batch():
     assert BatchedRunner(apply_fn, batch_size=1)._sharding is None
 
 
-def test_dp_rounded_bucket_fits_ring_segment():
-    """batch_size not a multiple of the device count: buckets round UP
-    (50 -> 56 on 8 devices), and the native ring slot segment must be
-    sized for the largest bucket, not batch_size (regression: every full
-    batch used to overflow its slot)."""
+def test_dp_non_multiple_batch_size_end_to_end():
+    """batch_size not a multiple of the device count (50 on 8 devices,
+    chunks at 48): ragged row counts flow through the ring feed without
+    slot-segment overflows (regression: rounded buckets once exceeded the
+    batch_size-derived segment) and outputs are exact."""
     runner = BatchedRunner(apply_fn, batch_size=50)
-    assert max(runner._buckets) > 50
     rows = _rows(100, seed=3)
     out = np.stack(list(runner.run(iter(rows))))
     want = np.stack([r["x"] * 2.0 + 1.0 for r in rows])
