@@ -117,10 +117,21 @@ def _eval_trial(objective, i, params) -> dict:
                 "status": "fail", "error": repr(e)}
 
 
-def _run_trials_processes(objective, candidates, parallelism) -> list[dict]:
+def _run_trials_processes(objective, candidates, parallelism,
+                          pin_devices: "list[int] | None" = None
+                          ) -> list[dict]:
     """Each trial in a FRESH interpreter (own jax runtime/devices), at
     most ``parallelism`` concurrent — the single-host analogue of
-    SparkTrials' executor-side evaluation."""
+    SparkTrials' executor-side evaluation.
+
+    On a TPU host, concurrent fresh interpreters contend for the libtpu
+    lock, so each trial is PINNED to one local chip
+    (``runner.backends.tpu_chip_pin_overrides``, round-robin over a free
+    pool); trials beyond the chip count queue for a free chip rather
+    than deadlocking. ``pin_devices`` overrides the autodetected chip
+    list (``local_pinnable_chips``); CPU hosts detect no chips and run
+    unpinned.
+    """
     import subprocess
     import sys
     import tempfile
@@ -128,8 +139,23 @@ def _run_trials_processes(objective, candidates, parallelism) -> list[dict]:
 
     import cloudpickle
 
+    from sparkdl_tpu.runner.backends import (
+        local_pinnable_chips,
+        tpu_chip_pin_overrides,
+    )
+
+    if pin_devices is None:
+        pin_devices = local_pinnable_chips()
+    if pin_devices and parallelism > len(pin_devices):
+        logger.warning(
+            "trial_runner='processes' parallelism=%d exceeds the %d local "
+            "chip(s); excess trials queue for a free chip (pass a smaller "
+            "parallelism to silence this)", parallelism, len(pin_devices),
+        )
+    free_chips = list(pin_devices)
+
     pending = list(enumerate(candidates))
-    running: dict = {}  # popen -> (tid, params, result_path)
+    running: dict = {}  # popen -> (tid, params, result_path, chip)
     results: list[dict] = []
 
     with tempfile.TemporaryDirectory(prefix="sparkdl_hpo_") as workdir:
@@ -139,22 +165,32 @@ def _run_trials_processes(objective, candidates, parallelism) -> list[dict]:
             with open(payload, "wb") as f:
                 cloudpickle.dump(
                     {"objective": objective, "params": params}, f)
+            chip = None
+            env = None
+            if free_chips:
+                chip = free_chips.pop(0)
+                env = os.environ.copy()
+                env.update(tpu_chip_pin_overrides(chip))
             p = subprocess.Popen(
                 [sys.executable, "-m", "sparkdl_tpu._trial_worker",
                  payload, result],
+                env=env,
             )
-            running[p] = (i, params, result)
+            running[p] = (i, params, result, chip)
 
         try:
             while pending or running:
-                while pending and len(running) < max(1, parallelism):
+                while (pending and len(running) < max(1, parallelism)
+                       and (not pin_devices or free_chips)):
                     launch(*pending.pop(0))
                 done = [p for p in running if p.poll() is not None]
                 if not done:
                     _time.sleep(0.05)
                     continue
                 for p in done:
-                    i, params, rpath = running.pop(p)
+                    i, params, rpath, chip = running.pop(p)
+                    if chip is not None:
+                        free_chips.append(chip)
                     try:
                         with open(rpath, "rb") as f:
                             r = cloudpickle.load(f)

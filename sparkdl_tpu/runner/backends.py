@@ -51,6 +51,44 @@ def virtual_cpu_overrides(n_devices: int, existing_flags: str = "") -> dict:
     return {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": " ".join(flags)}
 
 
+def tpu_chip_pin_overrides(chip: int) -> dict:
+    """Env overrides pinning a child process to ONE local TPU chip.
+
+    The companion of :func:`virtual_cpu_overrides` for real hardware:
+    concurrent single-host child interpreters (process trial runners,
+    per-chip workers) must each see a disjoint chip, or they deadlock on
+    the libtpu lock. Must be in the child env before it imports jax.
+    """
+    return {
+        "TPU_VISIBLE_DEVICES": str(chip),
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": "1,1,1",
+        "TPU_PROCESS_BOUNDS": "1,1,1",
+    }
+
+
+def local_pinnable_chips() -> "list[int]":
+    """Chip indices available for per-process pinning on this host.
+
+    MUST NOT touch jax: initializing a backend here would make the
+    DRIVER process acquire every chip and starve the very children the
+    pins are for. Detection is chip-granular (TPU_VISIBLE_DEVICES takes
+    chip ids, and jax device counts are CORES — 2x the chips on some
+    generations): an existing TPU_VISIBLE_DEVICES restriction is
+    respected, else the host's /dev/accel* entries (one per chip on TPU
+    VMs) are counted. Empty on chipless/CPU hosts — fresh interpreters
+    don't contend there, so no pinning is needed.
+    """
+    import glob
+
+    env = os.environ.get("TPU_VISIBLE_DEVICES")
+    if env is not None:
+        try:
+            return [int(x) for x in env.split(",") if x.strip() != ""]
+        except ValueError:
+            return []
+    return list(range(len(glob.glob("/dev/accel*"))))
+
+
 class LocalProcessBackend:
     """Run n ranks as subprocesses of this host (HorovodRunner np<0 mode).
 

@@ -85,6 +85,65 @@ def test_process_trials_isolated_interpreters():
     assert [t["tid"] for t in trials.trials] == list(range(8))
 
 
+def test_process_trials_pin_disjoint_devices():
+    """On a chip-ful host the processes runner pins each concurrent trial
+    to its own chip (env must precede the child's jax import) and queues
+    excess trials for a free chip instead of oversubscribing."""
+    from sparkdl_tpu.hpo import _run_trials_processes
+
+    def objective(p):
+        import os
+        import time
+        time.sleep(0.3)  # hold the chip so concurrent trials overlap
+        return {
+            "loss": p["x"],
+            "chip": os.environ.get("TPU_VISIBLE_DEVICES"),
+            "bounds": os.environ.get("TPU_PROCESS_BOUNDS"),
+        }
+
+    # 2 chips, 2 concurrent trials: each sees its own chip
+    res = _run_trials_processes(
+        objective, [{"x": 0.0}, {"x": 1.0}], parallelism=2,
+        pin_devices=[3, 5],
+    )
+    assert sorted(r["chip"] for r in res) == ["3", "5"]
+    assert all(r["bounds"] == "1,1,1" for r in res)
+
+    # 3 trials on 2 chips with parallelism=3: never oversubscribed —
+    # every trial still lands on one of the two pinned chips
+    res = _run_trials_processes(
+        objective, [{"x": float(i)} for i in range(3)], parallelism=3,
+        pin_devices=[0, 1],
+    )
+    assert len(res) == 3 and all(r["status"] == "ok" for r in res)
+    assert {r["chip"] for r in res} <= {"0", "1"}
+
+    # CPU hosts detect no chips: unpinned, env untouched
+    res = _run_trials_processes(
+        objective, [{"x": 0.0}], parallelism=1,
+    )
+    assert res[0]["chip"] is None
+
+
+def test_local_pinnable_chips_detection(monkeypatch):
+    """Chip detection never initializes jax (the driver would acquire
+    every chip): it honors an existing TPU_VISIBLE_DEVICES restriction,
+    else counts /dev/accel* entries (chip-granular, unlike jax device
+    counts which are cores)."""
+    from sparkdl_tpu.runner import backends
+
+    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "2,3")
+    assert backends.local_pinnable_chips() == [2, 3]
+    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "")
+    assert backends.local_pinnable_chips() == []
+    monkeypatch.delenv("TPU_VISIBLE_DEVICES")
+    monkeypatch.setattr(
+        "glob.glob", lambda pat: ["/dev/accel0", "/dev/accel1"]
+        if pat == "/dev/accel*" else [],
+    )
+    assert backends.local_pinnable_chips() == [0, 1]
+
+
 class _FakeRDD:
     def __init__(self, data):
         self.data = data
