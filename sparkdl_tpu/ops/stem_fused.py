@@ -1,0 +1,380 @@
+"""Whole-stem Pallas kernel: InceptionV3 stem as ONE Mosaic program.
+
+VERDICT r4 directive 1: the per-fusion ceiling table (PERF.md round 3)
+localizes the recoverable inference time in the lane-starved stem —
+conv1 u8 3→32 @149², two C≤64 3x3 convs @147², maxpool — ~2.2 ms of the
+12.62 ms program at 30-74% efficiency, with every piecewise lever
+(grouped convs, per-op Pallas islands) chip-measured dead. This kernel
+is the named untried shape: the WHOLE stem as one program, layouts
+internal, boundaries only at the u8 input and the small 73²×64 output,
+so the Mosaic layout tax that killed per-op islands does not apply.
+
+Design notes (why each choice):
+
+- **One image per grid step.** The full intermediate chain for one image
+  (~6.5 MB bf16) fits VMEM, so there is no halo exchange at all; Mosaic
+  pipelining prefetches image b+1's DMA during image b's compute.
+- **Flat [rows*W, C] layout + static slices.** All convs run on
+  2-D row-major flattenings whose reshapes ([R, W, C] <-> [R*W, C],
+  leading-dim splits) are layout-preserving in Mosaic. Shifted conv taps
+  are STATIC slices of the flat array (the band carries its own halo
+  rows); column wrap-around junk is confined to masked columns.
+- **Row-pair packed GEMMs.** A plain im2col of a C=32 conv is
+  [M, 288] @ [288, 32]: K fills the 128-lane contraction but N=32 uses a
+  quarter of the MXU's output columns — the same starvation that caps
+  XLA's stem fusions. Packing TWO output rows into the N dim
+  (N = 2×C = 64/64/128 here, K = the 4-row tap union = 72/384/384)
+  doubles PE utilization at a 1.33x MAC overhead: the only GEMM shape
+  with a chance against XLA's spatial-packed conv lowering at C<=64.
+- **Stride-2 conv1 via space-to-depth outside the kernel.** The u8
+  [B,299,299,3] -> [B,150,150,12] rearrange is a cheap XLA byte shuffle
+  (34 MB); it turns the strided conv into a stride-1 2x2 conv whose taps
+  are plain slices.
+- **BN + 'tf'-preprocess folded into weights/scale/bias** (inference
+  stem: conv-BN-relu with use_scale=False, eps=1e-3 — models/common.py).
+- **SAME padding and pooling via zero-masked junk columns**: keeping the
+  full flat width through the chain means a roll past a row end lands in
+  a zeroed junk column, which implements SAME padding exactly; the
+  stride-2 pool picks even rows/columns with layout-preserving
+  leading-dim reshape splits, never strided gathers.
+
+Oracle: tests/ops/test_stem_fused.py (interpret mode, small + full
+geometry) against the folded XLA stem; chip head-to-head in
+tools/bench_stem.py, result recorded in PERF.md either way.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+BAND = 16  # pool output rows per band (VMEM working-set knob)
+
+
+# ---------------------------------------------------------------------------
+# parameter folding / packing (numpy, oracle-testable)
+# ---------------------------------------------------------------------------
+
+
+def fold_stem_params(variables: dict, eps: float = 1e-3) -> dict:
+    """Extract conv000-002 + bn000-002 and fold BN into (scale, bias).
+
+    The zoo stem is bias-free conv + BatchNorm(use_scale=False), so
+    y = relu(conv(x) * s + b) with s = 1/sqrt(var+eps), b = bias - mean*s.
+    Works on plain or fold_tf_preprocess'ed variables (the fold only
+    rescales conv000's kernel / shifts bn000's mean).
+    """
+    p, st = variables["params"], variables["batch_stats"]
+    out = {}
+    for i, name in enumerate(("000", "001", "002")):
+        k = np.asarray(p[f"conv{name}"]["kernel"], np.float32)
+        mean = np.asarray(st[f"bn{name}"]["mean"], np.float32)
+        var = np.asarray(st[f"bn{name}"]["var"], np.float32)
+        bias = np.asarray(p[f"bn{name}"]["bias"], np.float32)
+        s = 1.0 / np.sqrt(var + eps)
+        out[f"k{i + 1}"] = k
+        out[f"s{i + 1}"] = s
+        out[f"b{i + 1}"] = bias - mean * s
+    return out
+
+
+def _pack_pair_weights(k: np.ndarray, n_tap_rows: int,
+                       row_of_tap) -> np.ndarray:
+    """[kh,kw,ci,co] -> [n_tap_rows*kw*ci, 2*co] row-pair GEMM matrix.
+
+    Row block (dy, dx, ci) feeds output block (p, co) with weight
+    k[row_of_tap(dy, p), dx, ci, co] when that kernel row exists.
+    """
+    kh, kw, ci, co = k.shape
+    b = np.zeros((n_tap_rows, kw, ci, 2, co), np.float32)
+    for dy in range(n_tap_rows):
+        for pp in range(2):
+            ky = row_of_tap(dy, pp)
+            if 0 <= ky < kh:
+                b[dy, :, :, pp, :] = k[ky]
+    return b.reshape(n_tap_rows * kw * ci, 2 * co)
+
+
+def pack_stem_params(folded: dict) -> dict:
+    """Fold -> the kernel's GEMM operands (see kernel layout contract)."""
+    k1, k2, k3 = folded["k1"], folded["k2"], folded["k3"]
+    # conv1 on space-to-depth cells: cell (cy, cx) phase (py, px) channel
+    # c is original tap (2cy+py, 2cx+px, c); s2d channel = (py*2+px)*3+c.
+    k1c = np.zeros((3, 2, 12, 32), np.float32)  # [cell_dy, cell_dx, cc, co]
+    for cy in range(2):
+        for cx in range(2):
+            for py in range(2):
+                for px in range(2):
+                    ky, kx = 2 * cy + py, 2 * cx + px
+                    if ky < 3 and kx < 3:
+                        cc = (py * 2 + px) * 3
+                        k1c[cy, cx, cc:cc + 3, :] = k1[ky, kx]
+    # pair p of conv1 covers s2d cell rows (p + dy_rel): row_of_tap maps
+    # tap row dy (0..2) to the kernel cell row dy - p (0..1)
+    w1 = _pack_pair_weights(k1c, 3, lambda dy, pp: dy - pp)  # [72, 64]
+    w2 = _pack_pair_weights(k2, 4, lambda dy, pp: dy - pp)  # [384, 64]
+    w3 = _pack_pair_weights(k3, 4, lambda dy, pp: dy - pp)  # [384, 128]
+    return {
+        "w1": w1, "w2": w2, "w3": w3,
+        "sb1": np.stack([np.tile(folded["s1"], 2),
+                         np.tile(folded["b1"], 2)]),   # [2, 64]
+        "sb2": np.stack([np.tile(folded["s2"], 2),
+                         np.tile(folded["b2"], 2)]),   # [2, 64]
+        "sb3": np.stack([np.tile(folded["s3"], 2),
+                         np.tile(folded["b3"], 2)]),   # [2, 128]
+    }
+
+
+def space_to_depth(x_u8: jax.Array) -> jax.Array:
+    """[B, S, S, 3] u8 -> [B, (S+1)//2, (S+1)//2, 12] u8 (XLA-side)."""
+    b, s, _, c = x_u8.shape
+    hs = (s + 1) // 2
+    pad = 2 * hs - s
+    x = jnp.pad(x_u8, ((0, 0), (0, pad), (0, pad), (0, 0)))
+    x = x.reshape(b, hs, 2, hs, 2, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, hs, hs, 12)
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+
+def _band_plan(rp: int):
+    """Static per-band row bookkeeping over pool output rows."""
+    bands = []
+    for u0 in range(0, rp, BAND):
+        u1 = min(u0 + BAND, rp)
+        np3 = (u1 - u0) + 1          # conv3 row pairs
+        np2 = np3 + 2                # conv2 row pairs
+        np1 = np2 + 2                # conv1 row pairs
+        bands.append((u0, u1, np3, np2, np1))
+    return bands
+
+
+def _rows(flat, fw, n_rows, start, count):
+    """[n_rows*fw, C] flat -> [count*fw, C] rows [start, start+count),
+    zero-filled outside [0, n_rows). All-static concat of slices."""
+    c = flat.shape[-1]
+    pieces = []
+    top = min(max(0, -start), count)
+    if top:
+        pieces.append(jnp.zeros((top * fw, c), flat.dtype))
+    lo = min(max(start, 0), n_rows)
+    hi = min(max(start + count, 0), n_rows)
+    if hi > lo:
+        pieces.append(flat[lo * fw:hi * fw])
+    bot = count - top - (hi - lo)
+    if bot:
+        pieces.append(jnp.zeros((bot * fw, c), flat.dtype))
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces, 0)
+
+
+def _split_even_odd(flat, fw, n_rows):
+    """[n_rows*fw, C] (n_rows even) -> (even, odd) [n_rows//2*fw, C]."""
+    c = flat.shape[-1]
+    x = flat.reshape(n_rows // 2, 2, fw, c)
+    return (x[:, 0].reshape(-1, c), x[:, 1].reshape(-1, c))
+
+
+def _pair_gemm(src_flat, fw, n_src_rows, base, n_pairs, tap_rows, tap_cols,
+               w, sb, out_dtype, col_shift: int = 0):
+    """Row-pair conv GEMM.
+
+    Pair p computes output rows (base+2p, base+2p+1) whose taps read
+    src rows base+2p+dy (dy < tap_rows) and cols x+dx+col_shift
+    (dx < tap_cols; col_shift=-1 gives a SAME conv's left column, with
+    the out-of-range element zero-filled). Returns [n_pairs*fw, 2*co]
+    = relu(A @ w * s + b).
+    """
+    # halo: dy//2 reaches n_pairs+ceil(tap_rows/2) rows per parity split
+    half = -(-tap_rows // 2)
+    need = 2 * (n_pairs + half)
+    src = _rows(src_flat, fw, n_src_rows, base, need)
+    ev, od = _split_even_odd(src, fw, need)
+    parts = []
+    m = n_pairs * fw
+    c = src_flat.shape[-1]
+    for dy in range(tap_rows):
+        half_src = ev if dy % 2 == 0 else od
+        row_off = dy // 2
+        for dx in range(tap_cols):
+            off = row_off * fw + dx + col_shift
+            if off < 0:
+                parts.append(jnp.concatenate(
+                    [jnp.zeros((-off, c), src_flat.dtype),
+                     half_src[:m + off]], axis=0))
+            else:
+                parts.append(half_src[off:off + m])
+    a = jnp.concatenate(parts, axis=1)  # [m, tap_rows*tap_cols*ci]
+    acc = jax.lax.dot_general(
+        a, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.maximum(acc * sb[0:1] + sb[1:2], 0.0).astype(out_dtype)
+
+
+def _interleave_pairs(packed, fw, n_pairs, co):
+    """[n_pairs*fw, 2*co] (lanes = (parity, ch)) -> [2*n_pairs*fw, co]."""
+    ev = packed[:, :co].reshape(n_pairs, fw, co)
+    od = packed[:, co:].reshape(n_pairs, fw, co)
+    return jnp.stack([ev, od], axis=1).reshape(2 * n_pairs * fw, co)
+
+
+def _zero_cols(flat, fw, n_rows, w_valid):
+    """Zero columns >= w_valid of a [n_rows*fw, C] flat array."""
+    if w_valid >= fw:
+        return flat
+    c = flat.shape[-1]
+    x = flat.reshape(n_rows, fw, c)
+    x = jnp.concatenate(
+        [x[:, :w_valid], jnp.zeros((n_rows, fw - w_valid, c), flat.dtype)],
+        axis=1,
+    )
+    return x.reshape(n_rows * fw, c)
+
+
+def _stem_kernel(x_ref, w1_ref, w2_ref, w3_ref, sb1_ref, sb2_ref, sb3_ref,
+                 o_ref, *, hs: int, rp: int, dtype):
+    fw = hs
+    r1 = hs - 1       # conv1 output rows (2x2 valid on s2d)
+    r2 = r1 - 2       # conv2 output rows / conv3 (SAME) rows
+    w2v = fw - 3      # valid columns after conv2 (and conv3)
+    # Mosaic has no u8->float casts; widen to i32 first
+    x = (x_ref[0].astype(jnp.int32).astype(jnp.float32)
+         .astype(dtype).reshape(hs * hs, 12))
+    w1 = w1_ref[...].astype(dtype)
+    w2 = w2_ref[...].astype(dtype)
+    w3 = w3_ref[...].astype(dtype)
+    sb1, sb2, sb3 = sb1_ref[...], sb2_ref[...], sb3_ref[...]
+
+    for u0, u1, np3, np2, np1 in _band_plan(rp):
+        nb = u1 - u0
+        g2 = 2 * u0 - 1   # conv2/out2 global start row (may be -1)
+        # conv1: pairs over out1 rows starting at g2 (= conv2's input)
+        out1 = _pair_gemm(x, fw, hs, g2, np1, 3, 2, w1, sb1, dtype)
+        out1_i = _interleave_pairs(out1, fw, np1, 32)  # local rows g2+...
+        # conv2 (valid 3x3): pair p -> out2 rows g2+2p, +1; taps read out1
+        # local rows 2p+dy (local base 0 == global g2)
+        out2 = _pair_gemm(out1_i, fw, 2 * np1, 0, np2, 4, 3, w2, sb2,
+                          dtype)
+        out2_i = _interleave_pairs(out2, fw, np2, 32)
+        # SAME padding: junk cols AND out-of-range rows must read zero.
+        # _pair_gemm zero-fills rows outside the local buffer, but rows
+        # INSIDE the local buffer that are outside the image (global <0 or
+        # >= r2) carry conv garbage -> zero them here (top band's row -1,
+        # bottom band's rows >= r2).
+        m2 = 2 * np2
+        out2_i = _zero_cols(out2_i, fw, m2, w2v)
+        kill_top = max(0, -g2)
+        kill_bot = max(0, (g2 + m2) - r2)
+        if kill_top or kill_bot:
+            keep = m2 - kill_top - kill_bot
+            z32 = functools.partial(jnp.zeros, dtype=dtype)
+            out2_i = jnp.concatenate(
+                ([z32((kill_top * fw, 32))] if kill_top else [])
+                + [out2_i[kill_top * fw:(kill_top + keep) * fw]]
+                + ([z32((kill_bot * fw, 32))] if kill_bot else []), 0)
+        # conv3 (SAME 3x3): conv3 row R reads out2 global R-1..R+1 =
+        # local (R - g2) - 1 + dy; pair p covers R = 2u0+2p, +1 ->
+        # local tap base 2p (since 2u0 - g2 - 1 = 0). col_shift=-1 is
+        # the SAME conv's left column (zero-filled / zeroed junk cols)
+        out3 = _pair_gemm(out2_i, fw, m2, 0, np3, 4, 3, w3, sb3, dtype,
+                          col_shift=-1)
+        out3_i = _interleave_pairs(out3, fw, np3, 64)   # rows 2u0+...
+        # maxpool 3x3 stride 2: stride-1 max via static shifts, then
+        # even-row/even-col selection via leading-dim reshape splits
+        m3 = 2 * np3
+        # one zero tail row so the (dy=2, dx=2) shifted slice stays in
+        # range (it only ever lands in discarded junk columns)
+        out3_ext = jnp.concatenate(
+            [out3_i, jnp.zeros((fw, 64), dtype)], axis=0)
+        mx = None
+        for dy in range(3):
+            for dx in range(3):
+                off = dy * fw + dx
+                sl = out3_ext[off:off + (m3 - 2) * fw]
+                mx = sl if mx is None else jnp.maximum(mx, sl)
+        nr = m3 - 2                      # stride-1 pooled rows (even count)
+        p3 = mx.reshape(nr // 2, 2, fw, 64)[:, 0]       # even rows [nb+?]
+        p3 = p3[:nb]                                     # [nb, fw, 64]
+        p3 = p3.reshape(nb, fw // 2, 2, 64)[:, :, 0]     # even cols
+        o_ref[0, u0:u1] = p3[:, :rp].astype(o_ref.dtype)
+
+
+def inception_stem_fused(x_u8: jax.Array, packed: dict, *,
+                         dtype=jnp.bfloat16,
+                         interpret: "bool | None" = None) -> jax.Array:
+    """u8 [B, S, S, 3] images -> [B, Rp, Rp, 64] stem features.
+
+    ``packed`` from :func:`pack_stem_params`. S odd (299 for the real
+    model; any S with (S+1)//2 even works — tests use S=59).
+    """
+    if interpret is None:
+        from sparkdl_tpu.ops._pallas import auto_interpret
+
+        interpret = auto_interpret()
+    b, s, _, _ = x_u8.shape
+    hs = (s + 1) // 2
+    if hs % 2:
+        raise ValueError(f"stem needs even (S+1)//2, got S={s}")
+    rp = ((hs - 3) - 3) // 2 + 1      # pool rows: ((hs-1-2) - 3)//2 + 1
+    xs = space_to_depth(x_u8)
+
+    to = lambda a, dt: jnp.asarray(a, dt)
+    w1 = to(packed["w1"], dtype)
+    w2 = to(packed["w2"], dtype)
+    w3 = to(packed["w3"], dtype)
+    sb1 = to(packed["sb1"], jnp.float32)
+    sb2 = to(packed["sb2"], jnp.float32)
+    sb3 = to(packed["sb3"], jnp.float32)
+
+    rep = lambda shape: pl.BlockSpec(shape, lambda i: tuple(
+        0 for _ in shape))
+    out = pl.pallas_call(
+        functools.partial(_stem_kernel, hs=hs, rp=rp, dtype=dtype),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, hs, hs, 12), lambda i: (i, 0, 0, 0)),
+            rep(w1.shape), rep(w2.shape), rep(w3.shape),
+            rep(sb1.shape), rep(sb2.shape), rep(sb3.shape),
+        ],
+        out_specs=pl.BlockSpec((1, rp, rp, 64), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, rp, rp, 64), dtype),
+        interpret=interpret,
+    )(xs, w1, w2, w3, sb1, sb2, sb3)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# XLA reference (oracle; also the head-to-head baseline on chip)
+# ---------------------------------------------------------------------------
+
+
+def stem_reference(x_u8: jax.Array, folded: dict,
+                   dtype=jnp.float32) -> jax.Array:
+    """The model's own stem math on the folded params (conv-BN-relu x3 +
+    maxpool), via XLA convs — what the kernel must match and beat."""
+    dn = ("NHWC", "HWIO", "NHWC")
+    x = x_u8.astype(dtype)
+
+    def cbr(x, k, s_, b_, strides, padding):
+        y = jax.lax.conv_general_dilated(
+            x, jnp.asarray(k, dtype), (strides, strides), padding,
+            dimension_numbers=dn,
+        )
+        return jnp.maximum(y * jnp.asarray(s_, dtype)
+                           + jnp.asarray(b_, dtype), 0.0)
+
+    x = cbr(x, folded["k1"], folded["s1"], folded["b1"], 2, "VALID")
+    x = cbr(x, folded["k2"], folded["s2"], folded["b2"], 1, "VALID")
+    x = cbr(x, folded["k3"], folded["s3"], folded["b3"], 1, "SAME")
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "VALID"
+    )

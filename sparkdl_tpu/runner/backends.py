@@ -79,14 +79,25 @@ def local_pinnable_chips() -> "list[int]":
     don't contend there, so no pinning is needed.
     """
     import glob
+    import re
 
     env = os.environ.get("TPU_VISIBLE_DEVICES")
     if env is not None:
         try:
             return [int(x) for x in env.split(",") if x.strip() != ""]
         except ValueError:
-            return []
-    return list(range(len(glob.glob("/dev/accel*"))))
+            logging.getLogger(__name__).warning(
+                "unparseable TPU_VISIBLE_DEVICES=%r; falling back to "
+                "device-file chip detection", env,
+            )
+    chips = []
+    # /dev/accel<N> (v2-v4 style) or /dev/vfio/<N> (vfio-exposed chips;
+    # the non-numeric /dev/vfio/vfio control node is skipped)
+    for path in glob.glob("/dev/accel*") + glob.glob("/dev/vfio/*"):
+        m = re.fullmatch(r"(?:accel)?(\d+)", os.path.basename(path))
+        if m:
+            chips.append(int(m.group(1)))
+    return sorted(set(chips))
 
 
 class LocalProcessBackend:

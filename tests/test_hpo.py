@@ -118,9 +118,10 @@ def test_process_trials_pin_disjoint_devices():
     assert len(res) == 3 and all(r["status"] == "ok" for r in res)
     assert {r["chip"] for r in res} <= {"0", "1"}
 
-    # CPU hosts detect no chips: unpinned, env untouched
+    # chipless pool: unpinned, env untouched (explicit [] keeps this
+    # hermetic on hosts where autodetection would find chips)
     res = _run_trials_processes(
-        objective, [{"x": 0.0}], parallelism=1,
+        objective, [{"x": 0.0}], parallelism=1, pin_devices=[],
     )
     assert res[0]["chip"] is None
 

@@ -1,0 +1,122 @@
+"""Mosaic capability probes behind the whole-stem kernel verdict.
+
+The whole-stem Pallas kernel (ops/stem_fused.py) needs an in-kernel
+im2col: concatenate row/col-shifted tap slices of an activation along
+lanes into the GEMM A-matrix [M, taps*C]. These probes document, with
+exact compiler errors from this chip's Mosaic, that every way of
+building that A-matrix is unimplemented — the structural reason the
+kernel cannot be compiled in its winning form (PERF.md round 5):
+
+  concat   lane-concat of sublane-offset tap slices
+           -> "Not implemented: result/input offset mismatch on
+               non-concat dimension"
+  ref      same, reading taps from a VMEM scratch ref -> same error
+           (ref loads keep the tracked offset)
+  add      arithmetic with an offset-0 operand does NOT normalize the
+           offset -> same error
+  roll     pltpu.roll to materialize taps at offset 0
+           -> "not implemented: Rotate with non-32-bit data" (bf16);
+           f32 rotates compile but cost ~3 VPU passes per tap — the
+           per-tap materialization arithmetic in PERF.md shows that
+           alone exceeds the stem's entire recoverable budget
+  einsum   contracting (tap, C) in one dot_general
+           -> "'tpu.matmul' op Not implemented: lhs contracting dims
+               must be of size 1"
+  rows     axis-0 (sublane) concat of offset slices -> COMPILES (the
+           one legal direction; unusable for a K-dim build)
+
+Run on the chip:  python tools/probe_mosaic_stem.py <case>
+Each case prints OK or surfaces the Mosaic error above.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _run(case: str):
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((272, 32)),
+                    jnp.bfloat16)
+
+    if case == "concat":
+        def k(x_ref, o_ref):
+            xx = x_ref[...]
+            o_ref[...] = jnp.concatenate(
+                [xx[i:i + 256] for i in range(12)], axis=1)
+
+        return pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((256, 384), jnp.bfloat16),
+            interpret=False)(x)
+
+    if case == "ref":
+        def k(x_ref, o_ref, scr):
+            scr[...] = x_ref[...] * 2.0
+            o_ref[...] = jnp.concatenate(
+                [scr[i:i + 256] for i in range(12)], axis=1)
+
+        return pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((256, 384), jnp.bfloat16),
+            scratch_shapes=[pltpu.VMEM((272, 32), jnp.bfloat16)],
+            interpret=False)(x)
+
+    if case == "add":
+        def k(x_ref, o_ref):
+            xx = x_ref[...]
+            z = jnp.zeros((256, 32), xx.dtype)
+            o_ref[...] = jnp.concatenate(
+                [xx[i:i + 256] + z for i in range(12)], axis=1)
+
+        return pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((256, 384), jnp.bfloat16),
+            interpret=False)(x)
+
+    if case == "roll":
+        def k(x_ref, o_ref):
+            xx = x_ref[...]
+            o_ref[...] = jnp.concatenate(
+                [pltpu.roll(xx, (272 - i) % 272, 0)[:256]
+                 for i in range(12)], axis=1)
+
+        return pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((256, 384), jnp.bfloat16),
+            interpret=False)(x)
+
+    if case == "einsum":
+        w = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (12, 32, 64)), jnp.bfloat16)
+
+        def k(x_ref, w_ref, o_ref):
+            xx = x_ref[...]
+            a = jnp.concatenate(
+                [xx[i:i + 256] for i in range(12)], axis=0)
+            a = a.reshape(12, 256, 32)
+            o_ref[...] = jax.lax.dot_general(
+                a, w_ref[...], (((0, 2), (0, 1)), ((), ())),
+                preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+        return pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((256, 64), jnp.bfloat16),
+            interpret=False)(x, w)
+
+    if case == "rows":
+        def k(x_ref, o_ref):
+            xx = x_ref[...]
+            o_ref[...] = jnp.concatenate(
+                [xx[i:i + 64] for i in range(12)], axis=0)
+
+        return pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((768, 32), jnp.bfloat16),
+            interpret=False)(x)
+
+    raise SystemExit(f"unknown case {case!r}; see module docstring")
+
+
+if __name__ == "__main__":
+    out = _run(sys.argv[1] if len(sys.argv) > 1 else "concat")
+    print(sys.argv[1], "OK", np.asarray(out).shape)
