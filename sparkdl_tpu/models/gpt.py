@@ -55,13 +55,20 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     dropout: float = 0.0
     #: "full" | "flash" (Pallas fused kernels) | "ring" (sp-sharded).
-    #: "flash" covers the uncached forward (ops/flash_attention),
-    #: single-token KV-cached decode (ops/flash_decode), AND cached
-    #: prefill with a concrete idx (flash over the written prefix with a
-    #: static causal q-offset — O(idx+L) keys, not O(max_len)); only a
-    #: traced-idx prefill (jitted streaming callers) falls back to the
-    #: dense masked path.
+    #: "flash" covers the uncached forward (ops/flash_attention) AND
+    #: cached prefill with a concrete idx (flash over the written prefix
+    #: with a static causal q-offset — O(idx+L) keys, not O(max_len);
+    #: 7.6x vs dense-over-buffer on chip). Only a traced-idx prefill
+    #: (jitted streaming callers) falls back to the dense masked path.
     attn_impl: str = "full"
+    #: opt-in ops/flash_decode kernel for the single-token cached step.
+    #: Default OFF: chip-measured 0.24x of the dense path at serving
+    #: shape (batch 64, L=4096, bench_attention.py round 5) — XLA's
+    #: dense decode runs at the HBM roofline while the kernel's
+    #: half-lane-tile D=64 blocks and per-(b,h) programs read the cache
+    #: inefficiently. The kernel stays correct (oracle + ragged start
+    #: masking) for shapes where streaming wins.
+    flash_decode: bool = False
     sp_axis: str = "sp"
     #: 0 = dense MLPs; >0 = MoE with this many experts
     num_experts: int = 0
@@ -156,9 +163,10 @@ class GPTAttention(nn.Module):
                 (0, idx, 0, 0),
             )
             new_entry = (ck, cv)
-            if c.attn_impl == "flash" and l == 1:
-                # the serving hot loop: single-query flash decode streams
-                # the cache once, no [B,H,1,L] scores in HBM
+            if c.attn_impl == "flash" and l == 1 and c.flash_decode:
+                # opt-in single-query flash decode (see GPTConfig:
+                # dense wins at serving shapes; kernel kept for shapes
+                # where streaming the cache beats the score round-trip)
                 from sparkdl_tpu.ops.flash_decode import flash_decode
 
                 start = None

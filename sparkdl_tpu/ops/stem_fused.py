@@ -46,7 +46,6 @@ tools/bench_stem.py, result recorded in PERF.md either way.
 from __future__ import annotations
 
 import functools
-import math
 
 import jax
 import jax.numpy as jnp

@@ -86,18 +86,29 @@ def local_pinnable_chips() -> "list[int]":
         try:
             return [int(x) for x in env.split(",") if x.strip() != ""]
         except ValueError:
-            logging.getLogger(__name__).warning(
+            logger.warning(
                 "unparseable TPU_VISIBLE_DEVICES=%r; falling back to "
                 "device-file chip detection", env,
             )
-    chips = []
-    # /dev/accel<N> (v2-v4 style) or /dev/vfio/<N> (vfio-exposed chips;
-    # the non-numeric /dev/vfio/vfio control node is skipped)
-    for path in glob.glob("/dev/accel*") + glob.glob("/dev/vfio/*"):
-        m = re.fullmatch(r"(?:accel)?(\d+)", os.path.basename(path))
-        if m:
-            chips.append(int(m.group(1)))
-    return sorted(set(chips))
+    # /dev/accel<N>: N IS the chip index
+    chips = sorted(
+        int(m.group(1))
+        for m in (re.fullmatch(r"accel(\d+)", os.path.basename(p))
+                  for p in glob.glob("/dev/accel*"))
+        if m
+    )
+    if chips:
+        return chips
+    # vfio-exposed hosts: /dev/vfio/<N> are IOMMU GROUP numbers, not
+    # chip ids — TPU_VISIBLE_DEVICES wants logical chip indices, so
+    # return 0..count-1 and only the numeric entries (skips the
+    # /dev/vfio/vfio control node; non-TPU vfio devices would
+    # overcount, but accel-style hosts never reach this branch)
+    n = sum(
+        1 for p in glob.glob("/dev/vfio/*")
+        if re.fullmatch(r"\d+", os.path.basename(p))
+    )
+    return list(range(n))
 
 
 class LocalProcessBackend:

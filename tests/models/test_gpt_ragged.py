@@ -36,10 +36,16 @@ def _left_pad(prompts):
     return jnp.asarray(ids), jnp.asarray(mask), lp
 
 
-@pytest.mark.parametrize("attn_impl", ["full", "flash"])
+@pytest.mark.parametrize("attn_impl,flash_decode", [
+    ("full", False),
+    ("flash", False),  # DEFAULT flash config: flash prefill+dense decode
+    ("flash", True),   # opt-in kernel decode: per-row start masking
+])
 @pytest.mark.parametrize("positions", ["rope", "learned"])
-def test_ragged_batched_matches_unbatched(attn_impl, positions):
-    cfg = GPTConfig.tiny(attn_impl=attn_impl, positions=positions)
+def test_ragged_batched_matches_unbatched(attn_impl, positions,
+                                          flash_decode):
+    cfg = GPTConfig.tiny(attn_impl=attn_impl, positions=positions,
+                         flash_decode=flash_decode)
     model = GPTLMHeadModel(cfg)
     variables = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)

@@ -24,6 +24,10 @@ kernel cannot be compiled in its winning form (PERF.md round 5):
                must be of size 1"
   rows     axis-0 (sublane) concat of offset slices -> COMPILES (the
            one legal direction; unusable for a K-dim build)
+  train-stage  the SAME A-build at the ResNet50 56² training stage's
+           C=128 ([M, 9*128]) -> identical "offset mismatch" error, so
+           the round-3 whole-backbone training route is blocked by the
+           same lowering (chip-verified 2026-07-31)
 
 Run on the chip:  python tools/probe_mosaic_stem.py <case>
 Each case prints OK or surfaces the Mosaic error above.
@@ -113,6 +117,24 @@ def _run(case: str):
         return pl.pallas_call(
             k, out_shape=jax.ShapeDtypeStruct((768, 32), jnp.bfloat16),
             interpret=False)(x)
+
+    if case == "train-stage":
+        # the TRAINING whole-stage route's exact A-build: a 3x3 conv at
+        # the ResNet50 56² stage's C=128, im2col'd in-kernel to
+        # [M, 9*128] — same lane-concat of offset tap slices, so the
+        # round-3 "whole-backbone GEMM-shaped program" is blocked by
+        # the identical unimplemented lowering
+        xl = jnp.asarray(np.random.default_rng(2).standard_normal(
+            (272, 128)), jnp.bfloat16)
+
+        def k(x_ref, o_ref):
+            xx = x_ref[...]
+            o_ref[...] = jnp.concatenate(
+                [xx[i:i + 256] for i in range(9)], axis=1)
+
+        return pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((256, 1152), jnp.bfloat16),
+            interpret=False)(xl)
 
     raise SystemExit(f"unknown case {case!r}; see module docstring")
 
