@@ -63,6 +63,24 @@ def main() -> None:
     reps = np.asarray(out[:, 4:]) == np.asarray(prompt[:, -1:])
     print(f"copy-task fidelity: {reps.mean():.2f}")
 
+    # Ragged serving batch: unequal-length prompts decode together.
+    # LEFT-pad and pass attention_mask — pad columns are excluded from
+    # attention and positions count real tokens only, so each row matches
+    # its unbatched decode (tests/models/test_gpt_ragged.py oracle).
+    prompts = [[7, 7, 7], [3]]
+    lp = max(len(p) for p in prompts)
+    ids = np.zeros((len(prompts), lp), np.int32)
+    mask = np.zeros((len(prompts), lp), np.int32)
+    for i, p in enumerate(prompts):
+        ids[i, lp - len(p):] = p
+        mask[i, lp - len(p):] = 1
+    rag = generate(model, params, jnp.asarray(ids), 6,
+                   attention_mask=jnp.asarray(mask))
+    print("ragged prompts:  ", prompts)
+    print("ragged generated:", np.asarray(rag[:, lp:]))
+    rreps = np.asarray(rag[:, lp:]) == ids[:, -1:]
+    print(f"ragged copy-task fidelity: {rreps.mean():.2f}")
+
 
 if __name__ == "__main__":
     main()
