@@ -503,6 +503,12 @@ def generate(
     sampled runs draw per-step noise shaped by the whole batch, so
     sampled rows match only in distribution. Output rows keep their left
     pads: ``[pads, prompt, generated]``.
+
+    Multi-chip serving: sharding-transparent. Commit ``prompt_ids`` (and
+    ``attention_mask``) to a dp mesh (``runtime.mesh.batch_sharding``)
+    and the prefill, every scan-carried cache update, and sampling run
+    SPMD over the local chips, token-identical to the unsharded run
+    (tests/models/test_gpt_dp.py).
     """
     b, lp = prompt_ids.shape
     if max_len is None:
