@@ -8,8 +8,9 @@ cd "$(dirname "$0")"
 
 # Two lanes (VERDICT r4 #8): the default lane skips @pytest.mark.slow —
 # the multi-process elastic/preemption jobs and full-size model oracles —
-# and finishes in well under 10 minutes. `./run-tests.sh --full` runs
-# everything (what CI and the driver's `pytest tests/` do).
+# and finishes under 10 minutes (355 tests in 9:42, idle host,
+# 2026-07-31). `./run-tests.sh --full` runs everything (what CI and the
+# driver's `pytest tests/` do).
 if [[ "${1:-}" == "--full" ]]; then
   shift
   python -m pytest tests/ -q "$@"

@@ -14,6 +14,11 @@ from sparkdl_tpu.models.inception_fused import fused_inception_v3_features
 from sparkdl_tpu.models.registry import build_flax_model
 
 
+# full-size InceptionV3 fixture (~70s); the fast lane relies on the zoo
+# contract tests + the full lane for the fused-forward oracle
+pytestmark = pytest.mark.slow
+
+
 @pytest.fixture(scope="module")
 def inception():
     return build_flax_model("InceptionV3", weights=None, include_top=False)

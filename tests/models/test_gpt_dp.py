@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel, generate
-from sparkdl_tpu.runtime.mesh import batch_sharding, data_parallel_mesh
+from sparkdl_tpu.runtime.mesh import batch_sharding
 
 rng = np.random.default_rng(17)
 
@@ -26,21 +26,21 @@ def _model(**kw):
     return model, variables
 
 
-def test_dp_sharded_generate_matches_unsharded():
+def test_dp_sharded_generate_matches_unsharded(eight_device_mesh):
     model, variables = _model()
     ids = jnp.asarray(rng.integers(0, 128, (8, 6)), jnp.int32)
     plain = generate(model, variables, ids, 5)
 
-    mesh = data_parallel_mesh(jax.devices())
     out = generate(
-        model, variables, jax.device_put(ids, batch_sharding(mesh)), 5
+        model, variables,
+        jax.device_put(ids, batch_sharding(eight_device_mesh)), 5,
     )
     assert isinstance(out.sharding, jax.sharding.NamedSharding)
     assert not out.sharding.is_fully_replicated  # batch dim stayed split
     np.testing.assert_array_equal(np.asarray(out), np.asarray(plain))
 
 
-def test_dp_sharded_ragged_generate():
+def test_dp_sharded_ragged_generate(eight_device_mesh):
     """Ragged left-padded serving batch sharded over the mesh: per-row
     masking and positions survive SPMD partitioning."""
     model, variables = _model()
@@ -51,8 +51,7 @@ def test_dp_sharded_ragged_generate():
     mask = jnp.asarray(mask)
 
     plain = generate(model, variables, ids, 4, attention_mask=mask)
-    mesh = data_parallel_mesh(jax.devices())
-    sh = batch_sharding(mesh)
+    sh = batch_sharding(eight_device_mesh)
     out = generate(
         model, variables, jax.device_put(ids, sh), 4,
         attention_mask=jax.device_put(mask, sh),

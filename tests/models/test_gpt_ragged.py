@@ -41,7 +41,11 @@ def _left_pad(prompts):
     ("flash", False),  # DEFAULT flash config: flash prefill+dense decode
     ("flash", True),   # opt-in kernel decode: per-row start masking
 ])
-@pytest.mark.parametrize("positions", ["rope", "learned"])
+@pytest.mark.parametrize("positions", [
+    "rope",
+    # learned positions duplicate the masking logic; full lane only
+    pytest.param("learned", marks=pytest.mark.slow),
+])
 def test_ragged_batched_matches_unbatched(attn_impl, positions,
                                           flash_decode):
     cfg = GPTConfig.tiny(attn_impl=attn_impl, positions=positions,

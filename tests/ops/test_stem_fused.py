@@ -30,7 +30,8 @@ def _random_folded(seed=0):
     return f
 
 
-@pytest.mark.parametrize("size", [59, 67])
+@pytest.mark.parametrize("size", [
+    59, pytest.param(67, marks=pytest.mark.slow)])
 def test_stem_kernel_matches_reference_small(size):
     folded = _random_folded()
     packed = pack_stem_params(folded)
