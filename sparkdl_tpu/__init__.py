@@ -22,6 +22,7 @@ _LAZY = {
     "DeepImagePredictor": "sparkdl_tpu.transformers.named_image",
     "KerasTransformer": "sparkdl_tpu.transformers.keras_tensor",
     "DeepTextFeaturizer": "sparkdl_tpu.transformers.text",
+    "DeepTextGenerator": "sparkdl_tpu.transformers.text_generator",
     "KerasImageFileTransformer": "sparkdl_tpu.transformers.keras_image",
     "TFTransformer": "sparkdl_tpu.transformers.tf_tensor",
     "TFImageTransformer": "sparkdl_tpu.transformers.tf_image",

@@ -4,10 +4,12 @@ from sparkdl_tpu.transformers.named_image import (
 )
 from sparkdl_tpu.transformers.keras_tensor import KerasTransformer
 from sparkdl_tpu.transformers.text import DeepTextFeaturizer
+from sparkdl_tpu.transformers.text_generator import DeepTextGenerator
 
 __all__ = [
     "DeepImageFeaturizer",
     "DeepImagePredictor",
     "KerasTransformer",
     "DeepTextFeaturizer",
+    "DeepTextGenerator",
 ]

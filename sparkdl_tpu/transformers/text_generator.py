@@ -1,0 +1,235 @@
+"""DeepTextGenerator — GPT generation over DataFrames.
+
+Serving-side sibling of :class:`DeepTextFeaturizer` (the reference has no
+text models at all — SURVEY.md 2.1 — but its transformer surface invites
+exactly this class): a column of prompt token-id arrays goes in, a column
+of generated token ids comes out. Unequal-length prompts in a batch
+decode TOGETHER via the ragged left-padded ``generate`` path
+(models/gpt.py): pad columns are excluded from every attention softmax,
+so each row's output equals its unbatched decode (greedy) while the whole
+batch shares one KV-cached ``lax.scan``.
+
+Execution shape: prompts bucket by (batch rows, padded prompt length) so
+each jitted generate program compiles once per bucket; on a multi-chip
+host the batch lands dp-sharded (``runtime.mesh.batch_sharding``) and the
+prefill + decode scan run SPMD — the same committed-input-sharding
+mechanism as BatchedRunner's data-parallel inference. Tokenization is
+upstream (bring your own tokenizer), mirroring the featurizer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sparkdl_tpu.dataframe import transform_partitions
+from sparkdl_tpu.param import (
+    HasBatchSize,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    SparkDLTypeConverters,
+    Transformer,
+)
+from sparkdl_tpu.runtime.batching import default_buckets, pick_bucket
+from sparkdl_tpu.transformers._inference import (
+    run_partition_with_passthrough,
+)
+from sparkdl_tpu.transformers.text import _fingerprint, _LruCache
+
+#: per-process jitted-generate cache, LRU-bounded like the featurizer's
+#: runner cache (key: weights fingerprint + config + decode params).
+_GEN_CACHE: _LruCache = _LruCache(maxsize=8)
+
+
+def _to_bundle(value):
+    from sparkdl_tpu.models.gpt import GPTConfig
+
+    if (
+        isinstance(value, tuple)
+        and len(value) == 2
+        and isinstance(value[0], GPTConfig)
+    ):
+        return value
+    raise TypeError(
+        "model must be a (GPTConfig, variables) tuple, e.g. from "
+        "models.gpt.load_hf_gpt2(...) or (cfg, GPTLMHeadModel(cfg).init(...))"
+    )
+
+
+class DeepTextGenerator(Transformer, HasInputCol, HasOutputCol, HasBatchSize):
+    """prompt token ids (array<int>) -> generated token ids (array<int>).
+
+    ``temperature=0`` (default) decodes greedily — deterministic, and each
+    row matches its unbatched decode. ``temperature>0`` samples with
+    optional ``topK``/``topP``; draws are deterministic per (seed, batch),
+    so re-running a partition reproduces its outputs.
+    """
+
+    model = Param(None, "model", "(GPTConfig, variables) decoder bundle",
+                  _to_bundle)
+    maxNewTokens = Param(None, "maxNewTokens",
+                         "number of tokens to generate per row",
+                         SparkDLTypeConverters.toInt)
+    maxLength = Param(
+        None, "maxLength",
+        "prompt cap: longer prompts keep their LAST maxLength tokens "
+        "(the continuation-relevant tail)", SparkDLTypeConverters.toInt)
+    temperature = Param(None, "temperature",
+                        "0 = greedy; >0 = sampled softmax temperature",
+                        SparkDLTypeConverters.toFloat)
+    topK = Param(None, "topK", "sample from the top-K logits only",
+                 SparkDLTypeConverters.toInt)
+    topP = Param(None, "topP", "nucleus sampling mass in (0, 1]",
+                 SparkDLTypeConverters.toFloat)
+    seed = Param(None, "seed", "sampling seed", SparkDLTypeConverters.toInt)
+
+    def __init__(self, inputCol=None, outputCol=None, model=None,
+                 maxNewTokens=None, maxLength=None, temperature=None,
+                 topK=None, topP=None, seed=None, batchSize=None):
+        super().__init__()
+        self._setDefault(maxNewTokens=32, maxLength=128, temperature=0.0,
+                         seed=0, batchSize=16)
+        self._set(inputCol=inputCol, outputCol=outputCol, model=model,
+                  maxNewTokens=maxNewTokens, maxLength=maxLength,
+                  temperature=temperature, topK=topK, topP=topP, seed=seed,
+                  batchSize=batchSize)
+
+    def setModel(self, value):
+        return self._set(model=value)
+
+    def _transform(self, dataset):
+        import jax
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.models.gpt import GPTLMHeadModel, generate
+
+        cfg, variables = self.getOrDefault("model")
+        max_new = self.getOrDefault("maxNewTokens")
+        max_len = self.getOrDefault("maxLength")
+        temperature = self.getOrDefault("temperature")
+        top_k = (self.getOrDefault("topK")
+                 if self.isDefined("topK") else None)
+        top_p = (self.getOrDefault("topP")
+                 if self.isDefined("topP") else None)
+        seed = self.getOrDefault("seed")
+        batch_size = self.getBatchSize()
+        input_col = self.getInputCol()
+        output_col = self.getOutputCol()
+        if cfg.positions == "learned" and max_len + max_new > cfg.max_seq_len:
+            raise ValueError(
+                f"maxLength {max_len} + maxNewTokens {max_new} exceeds the "
+                f"learned position table (max_seq_len={cfg.max_seq_len}); "
+                "lower them or use a RoPE config"
+            )
+        if temperature <= 0 and (top_k is not None or top_p is not None):
+            # fail fast on the driver; generate() would raise the same
+            # contract deep inside partition execution
+            raise ValueError(
+                "topK/topP only apply when sampling — set temperature > 0"
+            )
+        model = GPTLMHeadModel(cfg)
+
+        len_buckets = default_buckets(max_len, min_bucket=8)
+
+        def make_generate_fn():
+            # one jit per (rows, prompt_len) bucket, cached process-wide;
+            # mask validation is ours (left-padded by construction)
+            @jax.jit
+            def run(variables, ids, mask, key):
+                return generate(
+                    model, variables, ids, max_new,
+                    attention_mask=mask, temperature=temperature,
+                    top_k=top_k, top_p=top_p,
+                    rng=key if temperature > 0 else None,
+                )
+
+            return run
+
+        def extract(row):
+            ids = np.asarray(row[input_col], dtype=np.int32)
+            if ids.ndim != 1 or ids.size == 0:
+                raise ValueError(
+                    f"prompt must be a non-empty 1-D id array, got shape "
+                    f"{ids.shape}")
+            return ids[-max_len:]  # keep the continuation-relevant tail
+
+        class _GenRunner:
+            """run_partition_with_passthrough adapter: groups prompts,
+            buckets (rows, prompt_len) per group, generates, yields the
+            per-row generated ids in order."""
+
+            def __init__(self, run, sharding, chunk, row_buckets):
+                self._run = run
+                self._sharding = sharding
+                self._chunk = chunk
+                self._row_buckets = row_buckets
+
+            def run(self, prompts):
+                valid = list(prompts)
+                rng_counter = 0
+                for start in range(0, len(valid), self._chunk):
+                    group = valid[start:start + self._chunk]
+                    nb = pick_bucket(len(group), self._row_buckets)
+                    lp = pick_bucket(max(len(g) for g in group),
+                                     len_buckets)
+                    ids = np.zeros((nb, lp), np.int32)
+                    mask = np.zeros((nb, lp), np.int32)
+                    for i, g in enumerate(group):
+                        ids[i, lp - len(g):] = g
+                        mask[i, lp - len(g):] = 1
+                    mask[len(group):, -1] = 1  # pad rows: 1 real token
+                    if self._sharding is not None:
+                        # one sharded H2D transfer straight from numpy
+                        jids = jax.device_put(ids, self._sharding)
+                        jmask = jax.device_put(mask, self._sharding)
+                    else:
+                        jids, jmask = jnp.asarray(ids), jnp.asarray(mask)
+                    key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                             rng_counter)
+                    rng_counter += 1
+                    out = np.asarray(self._run(variables, jids, jmask, key))
+                    yield from (out[i, lp:] for i in range(len(group)))
+
+        def partition_fn(rows):
+            rows = list(rows)
+            if not rows:
+                return iter(())
+            key = (_fingerprint(variables), cfg, max_new, max_len,
+                   temperature, top_k, top_p, batch_size)
+            run = _GEN_CACHE.get(key)
+            if run is None:
+                run = _GEN_CACHE[key] = make_generate_fn()
+
+            # BatchedRunner's dp bucket discipline: round the chunk size
+            # DOWN to a device multiple, buckets up to multiples, so full
+            # groups hit their bucket exactly (no steady-state pad rows
+            # and one compile per bucket, not per device-count remainder)
+            n_local = jax.local_device_count()
+            sharding = None
+            chunk = batch_size
+            row_buckets = default_buckets(batch_size, min_bucket=4)
+            n_use = max(1, min(n_local, batch_size))
+            if n_use > 1:
+                from sparkdl_tpu.runtime.mesh import (
+                    batch_sharding,
+                    data_parallel_mesh,
+                )
+
+                sharding = batch_sharding(
+                    data_parallel_mesh(jax.local_devices()[:n_use]))
+                chunk = max(n_use, batch_size // n_use * n_use)
+                row_buckets = sorted({
+                    -(-b // n_use) * n_use
+                    for b in default_buckets(chunk, min_bucket=4)
+                })
+
+            runner = _GenRunner(run, sharding, chunk, row_buckets)
+            return run_partition_with_passthrough(
+                rows, extract, runner, output_col,
+                postprocess=lambda o: np.asarray(o).tolist(),
+                input_cols=(input_col,),
+            )
+
+        return transform_partitions(
+            dataset, partition_fn, [(output_col, "array<int>")]
+        )
