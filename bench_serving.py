@@ -1,0 +1,140 @@
+"""Online serving benchmark: dynamic micro-batching vs batch-of-1.
+
+Open-loop Poisson load (requests arrive on their own clock, regardless of
+completions — the honest way to load a server; closed-loop hides queueing
+collapse) replayed against two ServingEngines over the SAME jitted model:
+
+- micro:   dynamic micro-batching up to BENCH_MAX_BATCH rows/dispatch
+- batch-1: max_batch=1 — every request pays its own dispatch
+
+Driver contract: prints exactly ONE JSON line
+  {"metric": ..., "value": N, "unit": "req/s", "vs_baseline": N}
+value is the micro engine's completed throughput; vs_baseline is the
+throughput ratio micro / batch-of-1 at the same offered load (>= 3x is
+the ISSUE 1 acceptance bar on this harness), with both engines' p50/p95
+latency recorded in the metric string so the ratio can't hide a tail
+blowup.
+
+The model is a 4-layer MLP sized (BENCH_FEATURES=768) so the batch-of-1
+path sits in the weight-bound regime every real serving model lives in:
+one dispatch streams the full weight matrices through the core for ONE
+row, so 32 coalesced rows cost barely more than 1 — the regime where
+dynamic batching pays (and the regime a GPT decode step is always in:
+per-token cost is dominated by reading the weights + KV cache).
+
+Env knobs: BENCH_REQUESTS (default 512), BENCH_MAX_BATCH (32),
+BENCH_RATE (req/s; default auto = 4x the measured batch-of-1 capacity),
+BENCH_FEATURES (768), BENCH_LAYERS (4).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _replay(engine, arrivals):
+    """Open-loop: submit request i at absolute time arrivals[i]; wait for
+    everything; return (completed, duration_s, p50_ms, p95_ms)."""
+    rng = np.random.default_rng(1)
+    dim = int(os.environ.get("BENCH_FEATURES", "768"))
+    payloads = [
+        {"x": rng.standard_normal(dim).astype(np.float32)}
+        for _ in range(len(arrivals))
+    ]
+    futs = []
+    t0 = time.perf_counter()
+    for t_arr, payload in zip(arrivals, payloads):
+        lag = t0 + t_arr - time.perf_counter()
+        if lag > 0:
+            time.sleep(lag)
+        futs.append(engine.submit(payload))
+    for f in futs:
+        f.result(timeout=120)
+    duration = time.perf_counter() - t0
+    snap = engine.snapshot()
+    pcts = snap["latency_s"]
+    return (snap["completed"], duration,
+            1e3 * pcts["p50"], 1e3 * pcts["p95"],
+            snap["batch_occupancy_pct"])
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.serving import ServingEngine
+    from sparkdl_tpu.transformers._inference import BatchedRunner
+
+    platform = jax.default_backend()
+    n_req = int(os.environ.get("BENCH_REQUESTS", "512"))
+    max_batch = int(os.environ.get("BENCH_MAX_BATCH", "32"))
+    dim = int(os.environ.get("BENCH_FEATURES", "768"))
+    n_layers = int(os.environ.get("BENCH_LAYERS", "4"))
+
+    rng = np.random.default_rng(0)
+    ws = [jnp.asarray(rng.standard_normal((dim, dim)), jnp.float32) / dim
+          for _ in range(n_layers)]
+
+    def apply_fn(batch):
+        h = batch["x"]
+        for w in ws:
+            h = jnp.tanh(h @ w)
+        return h
+
+    def make_engine(batch_size):
+        runner = BatchedRunner(apply_fn, batch_size=batch_size,
+                               data_parallel=False)
+        # compile every bucket BEFORE measurement: steady-state serving is
+        # what's being compared, not first-request compile latency
+        for b in runner._buckets:
+            runner.run_batch({"x": np.zeros((b, dim), np.float32)})
+        return ServingEngine(
+            runner, max_queue_depth=max(n_req, 8), max_wait_s=0.002,
+        )
+
+    # calibrate: submit->result round trip of the batch-of-1 path
+    calib = make_engine(1)
+    x = {"x": np.zeros((dim,), np.float32)}
+    calib.submit(x).result(timeout=120)
+    t0 = time.perf_counter()
+    k = 30
+    for _ in range(k):
+        calib.submit(x).result(timeout=120)
+    per_request = (time.perf_counter() - t0) / k
+    calib.close()
+
+    # 6x the serialized capacity: far past batch-of-1 saturation (its
+    # queue must visibly build) while a >=32-row coalescer keeps up
+    rate = float(os.environ.get("BENCH_RATE", 0)) or 6.0 / per_request
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_req))
+
+    b1 = make_engine(1)
+    n_b1, dur_b1, p50_b1, p95_b1, _ = _replay(b1, arrivals)
+    b1.close()
+
+    micro = make_engine(max_batch)
+    n_mb, dur_mb, p50_mb, p95_mb, occ = _replay(micro, arrivals)
+    micro.close()
+
+    tput_b1 = n_b1 / dur_b1
+    tput_mb = n_mb / dur_mb
+    print(json.dumps({
+        "metric": (
+            f"online serving req/s, micro-batch<= {max_batch} vs batch-of-1 "
+            f"({platform}, {n_req} req, Poisson {rate:.0f}/s, "
+            f"p50/p95 ms {p50_mb:.1f}/{p95_mb:.1f} vs "
+            f"{p50_b1:.1f}/{p95_b1:.1f}, occupancy {occ:.0f}%)"
+        ),
+        "value": round(tput_mb, 1),
+        "unit": "req/s",
+        "vs_baseline": round(tput_mb / tput_b1, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

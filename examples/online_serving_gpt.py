@@ -1,0 +1,78 @@
+"""Online GPT serving with continuous batching (beyond-parity demo).
+
+Requests arrive one at a time, asynchronously; the engine keeps ONE
+persistent decode batch alive — finished prompts free their slot
+mid-stream and new prompts join the in-flight batch — so the chip stays
+busy without any caller ever waiting for a "batch" to form. Greedy
+outputs are token-identical to the unbatched ``generate`` decode: the
+batching is pure scheduling.
+
+Run: python examples/online_serving_gpt.py [--requests N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel, generate
+from sparkdl_tpu.serving import ContinuousGPTEngine
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=8)
+    args = ap.parse_args()
+
+    cfg = GPTConfig.tiny(vocab_size=64, max_seq_len=64)
+    model = GPTLMHeadModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+
+    engine = ContinuousGPTEngine(
+        cfg, variables, n_slots=4, max_len=48, idle_wait_s=0.001
+    )
+
+    # ragged prompts trickling in on their own clocks (an open-loop
+    # arrival process — nobody waits for anybody)
+    rng = np.random.default_rng(7)
+    cases = []
+    futures = []
+    for i in range(args.requests):
+        prompt = rng.integers(1, cfg.vocab_size,
+                              size=int(rng.integers(2, 9))).tolist()
+        max_new = int(rng.integers(3, 9))
+        cases.append((prompt, max_new))
+        futures.append(engine.submit(prompt, max_new))
+        time.sleep(float(rng.uniform(0.0, 0.01)))
+
+    engine.close(drain=True)  # graceful: every admitted request finishes
+
+    all_match = True
+    for (prompt, max_new), fut in zip(cases, futures):
+        got = fut.result(timeout=0)
+        want = np.asarray(generate(
+            model, variables, jnp.asarray([prompt], jnp.int32), max_new
+        )[0, len(prompt):])
+        ok = bool(np.array_equal(got, want))
+        all_match &= ok
+        print(f"prompt {prompt} -> {got.tolist()} "
+              f"({'ok' if ok else 'MISMATCH vs unbatched'})")
+
+    snap = engine.snapshot()
+    print(f"served {snap['completed']} prompts | "
+          f"occupancy {snap['batch_occupancy_pct']:.0f}% | "
+          f"latency p50/p95/p99 "
+          f"{1e3 * snap['latency_s']['p50']:.0f}/"
+          f"{1e3 * snap['latency_s']['p95']:.0f}/"
+          f"{1e3 * snap['latency_s']['p99']:.0f} ms")
+    print(f"continuous == unbatched: {all_match}")
+
+
+if __name__ == "__main__":
+    main()

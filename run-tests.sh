@@ -36,6 +36,15 @@ assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
 assert "over 8 devices" in rec["metric"], rec
 print("bench.py dp contract OK")
 '
+# Online serving bench: same one-JSON-line contract; vs_baseline is the
+# micro-batch / batch-of-1 throughput ratio under open-loop Poisson load.
+JAX_PLATFORMS=cpu BENCH_REQUESTS=64 python bench_serving.py | tail -1 | python -c '
+import json, sys
+rec = json.loads(sys.stdin.readline())
+assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
+assert "micro-batch" in rec["metric"], rec
+print("bench_serving contract OK")
+'
 # Secondary benches keep the same one-JSON-line contract (values are
 # CPU-smoke only; the real numbers come from the chip — PERF.md).
 for b in bench_tf_ingest.py bench_hostfed.py; do

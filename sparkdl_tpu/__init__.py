@@ -33,6 +33,8 @@ _LAZY = {
     "registerKerasImageUDF": "sparkdl_tpu.udf.keras_image_model",
     "TPURunner": "sparkdl_tpu.runner.tpu_runner",
     "HorovodRunner": "sparkdl_tpu.runner.tpu_runner",
+    "ServingEngine": "sparkdl_tpu.serving.engine",
+    "ContinuousGPTEngine": "sparkdl_tpu.serving.continuous",
     "imageIO": "sparkdl_tpu.image",
     "readImages": "sparkdl_tpu.image.imageIO",
     "readImagesWithCustomFn": "sparkdl_tpu.image.imageIO",

@@ -1,0 +1,30 @@
+"""jax cross-version API bridging — the one place spelling drift lives.
+
+The framework targets current jax but must run (tests and all) on older
+runtimes too; every symbol whose location moved between versions gets
+resolved here once, so call sites stay clean. Sibling helpers:
+:func:`sparkdl_tpu.runtime.mesh.mesh_context` (``jax.set_mesh`` vs the
+0.4.x Mesh context manager) and
+``parallel.tensor_parallel._active_mesh`` (``get_abstract_mesh``).
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map  # jax >= 0.6 top-level
+except AttributeError:  # jax < 0.6
+    from jax.experimental.shard_map import (  # type: ignore[no-redef]
+        shard_map as _experimental_shard_map,
+    )
+
+    def shard_map(*args, **kwargs):
+        # the replication-check escape hatch was renamed check_rep ->
+        # check_vma with the VMA type system; call sites use the current
+        # spelling, this bridge speaks the old one
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(*args, **kwargs)
+
+__all__ = ["shard_map"]

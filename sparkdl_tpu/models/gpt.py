@@ -100,18 +100,24 @@ def apply_rope(x: jax.Array, positions: jax.Array,
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
 
 
-def init_cache(config: GPTConfig, batch: int, max_len: int) -> dict:
+def init_cache(config: GPTConfig, batch: int, max_len: int,
+               per_slot: bool = False) -> dict:
     """Zeroed KV cache for :func:`generate` / incremental decode.
 
     Layout: k/v stacked over layers, [num_layers, B, max_len, H, D];
-    ``idx`` is the number of positions already written.
+    ``idx`` is the number of positions already written — a scalar for the
+    lockstep :func:`generate` path, or (``per_slot=True``) a per-row [B]
+    vector for continuous-batching serving where every batch row (slot)
+    decodes at its own depth (``serving.continuous``). Per-slot caches
+    support single-token steps only (L==1); prefill a joining row in its
+    own scalar-idx cache and scatter it in.
     """
     hd = config.hidden_size // config.num_heads
     shape = (config.num_layers, batch, max_len, config.num_heads, hd)
     return {
         "k": jnp.zeros(shape, config.dtype),
         "v": jnp.zeros(shape, config.dtype),
-        "idx": jnp.zeros((), jnp.int32),
+        "idx": jnp.zeros((batch,) if per_slot else (), jnp.int32),
     }
 
 
@@ -134,9 +140,14 @@ class GPTAttention(nn.Module):
         q, k, v = (t.reshape(b, l, nh, hd) for t in (q, k, v))
 
         idx = cache["idx"] if cache is not None else jnp.zeros((), jnp.int32)
+        #: per-slot cache: idx is [B] — every row decodes at its own depth
+        #: (continuous batching); scalar idx is the lockstep generate path
+        per_slot = jnp.ndim(idx) == 1
         if c.positions == "rope":
             if positions is None:
-                positions = idx + jnp.arange(l)[None, :]  # [1, L] broadcast
+                # [1|B, L] -> broadcast: scalar idx rows share positions,
+                # per-slot rows each count from their own depth
+                positions = jnp.reshape(idx, (-1, 1)) + jnp.arange(l)[None, :]
                 positions = jnp.broadcast_to(positions, (b, l))
             q = apply_rope(q, positions, c.rope_base)
             k = apply_rope(k, positions, c.rope_base)
@@ -149,21 +160,44 @@ class GPTAttention(nn.Module):
             # the mask keeps advancing — catch it whenever idx is concrete
             # (eager streaming drivers; generate() pre-validates its scan).
             max_len = cache["k"].shape[2]
-            if not isinstance(idx, jax.core.Tracer) and int(idx) + l > max_len:
+            if per_slot and l != 1:
+                raise ValueError(
+                    "per-slot caches (idx per row) support single-token "
+                    f"decode only, got L={l}; prefill a joining row in its "
+                    "own scalar-idx cache and scatter it into the slot"
+                )
+            if (not per_slot and not isinstance(idx, jax.core.Tracer)
+                    and int(idx) + l > max_len):
                 raise ValueError(
                     f"KV cache overflow: idx {int(idx)} + {l} new tokens > "
                     f"cache max_len {max_len}"
                 )
-            ck = jax.lax.dynamic_update_slice(
-                cache["k"][self.layer_idx], k.astype(c.dtype),
-                (0, idx, 0, 0),
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cache["v"][self.layer_idx], v.astype(c.dtype),
-                (0, idx, 0, 0),
-            )
+            if per_slot:
+                # Per-row scatter at column idx[b] — a true indexed
+                # scatter touching B rows, not a masked rewrite of the
+                # whole buffer. mode="drop" keeps the contract for rows
+                # whose idx lies past the buffer (idle/retired slots the
+                # serving engine has not reassigned yet): the write is
+                # dropped (never clamped onto column max_len-1) and the
+                # row stays garbage-but-finite — admission control owns
+                # capacity, not this kernel.
+                rows = jnp.arange(b)
+                ck = cache["k"][self.layer_idx].at[rows, idx].set(
+                    k[:, 0].astype(c.dtype), mode="drop")
+                cv = cache["v"][self.layer_idx].at[rows, idx].set(
+                    v[:, 0].astype(c.dtype), mode="drop")
+            else:
+                ck = jax.lax.dynamic_update_slice(
+                    cache["k"][self.layer_idx], k.astype(c.dtype),
+                    (0, idx, 0, 0),
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    cache["v"][self.layer_idx], v.astype(c.dtype),
+                    (0, idx, 0, 0),
+                )
             new_entry = (ck, cv)
-            if c.attn_impl == "flash" and l == 1 and c.flash_decode:
+            if (c.attn_impl == "flash" and l == 1 and c.flash_decode
+                    and not per_slot):
                 # opt-in single-query flash decode (see GPTConfig:
                 # dense wins at serving shapes; kernel kept for shapes
                 # where streaming the cache beats the score round-trip)
@@ -194,11 +228,14 @@ class GPTAttention(nn.Module):
                     causal=True, q_offset=int(idx),
                 )
             else:
-                # prefill (L>1) and non-flash decode: dense masked path
+                # prefill (L>1), non-flash decode, and every per-slot step:
+                # dense masked path. q_pos is [1, L] (lockstep) or [B, 1]
+                # (per-slot), so the causal mask is per-row exactly when
+                # rows sit at different depths.
                 max_len = ck.shape[1]
-                q_pos = idx + jnp.arange(l)  # [L]
+                q_pos = jnp.reshape(idx, (-1, 1)) + jnp.arange(l)  # [1|B, L]
                 k_pos = jnp.arange(max_len)  # [max_len]
-                mask = (k_pos[None, :] <= q_pos[:, None])[None, None]
+                mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None]
                 if attention_mask is not None:
                     # [B, max_len] buffer-column validity (pad columns of
                     # left-padded ragged prompts are False forever)
@@ -290,7 +327,11 @@ class GPTLMHeadModel(nn.Module):
     Without a cache: full causal forward (training / scoring), attention
     impl per ``config.attn_impl``. With a cache from :func:`init_cache`:
     writes K/V at ``cache['idx']`` and returns the updated cache —
-    the building block :func:`generate` scans.
+    the building block :func:`generate` scans. A PER-SLOT cache
+    (``init_cache(..., per_slot=True)``, ``idx`` [B]) decodes every row at
+    its own depth with a per-row causal mask and per-row K/V scatter —
+    single-token steps only, always the dense path — which is what lets
+    ``serving.continuous`` admit and retire rows mid-stream.
 
     ``positions``: optional [B, L] global token positions for RoPE.
     REQUIRED under ``attn_impl='ring'`` (sequence sharded on ``sp``): each
@@ -321,7 +362,9 @@ class GPTLMHeadModel(nn.Module):
             idx = cache["idx"] if cache is not None else jnp.zeros((), jnp.int32)
             pos = positions
             if pos is None:
-                pos = jnp.broadcast_to(idx + jnp.arange(l)[None, :], (b, l))
+                pos = jnp.broadcast_to(
+                    jnp.reshape(idx, (-1, 1)) + jnp.arange(l)[None, :], (b, l)
+                )
             x = x + nn.Embed(c.max_seq_len, c.hidden_size, dtype=c.dtype,
                              name="wpe")(pos)
         x = nn.Dropout(c.dropout, deterministic=not train)(x)

@@ -21,6 +21,7 @@ from sparkdl_tpu.observability.metrics import (
     aggregate_across_hosts,
     compiled_flops,
     device_peak_flops,
+    percentile,
 )
 from sparkdl_tpu.observability.profiling import start_trace_server, trace
 
@@ -31,6 +32,7 @@ __all__ = [
     "check_health",
     "compiled_flops",
     "device_peak_flops",
+    "percentile",
     "start_trace_server",
     "trace",
 ]

@@ -37,6 +37,31 @@ _PEAK_FLOPS: dict[str, float] = {
 }
 
 
+def percentile(values: "list[float] | tuple[float, ...]",
+               p: float) -> float | None:
+    """Linear-interpolated percentile (numpy's default method), stdlib-only
+    so meters never pay an array round-trip for a scalar.
+
+    ``p`` in [0, 100]; returns None on an empty sample.
+    """
+    return _percentile_sorted(sorted(values), p)
+
+
+def _percentile_sorted(s: "list[float]", p: float) -> float | None:
+    """percentile() on an already-sorted sample (one sort, many ps)."""
+    if not 0 <= p <= 100:
+        raise ValueError(f"percentile must be in [0, 100], got {p}")
+    if not s:
+        return None
+    if len(s) == 1:
+        return float(s[0])
+    rank = (p / 100.0) * (len(s) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(s) - 1)
+    frac = rank - lo
+    return float(s[lo] * (1.0 - frac) + s[hi] * frac)
+
+
 def device_peak_flops(device: "jax.Device | None" = None,
                       dtype: str = "bf16") -> float | None:
     """Best-effort peak FLOP/s of one chip; None when unknown (CPU, etc.)."""
@@ -148,6 +173,20 @@ class StepMeter:
 
     def mean_step_time(self) -> float | None:
         return statistics.fmean(self._times) if self._times else None
+
+    def step_time_percentile(self, p: float) -> float | None:
+        """Percentile of recorded step times over the window (p in
+        [0, 100]); None until a step is recorded."""
+        return percentile(list(self._times), p)
+
+    def step_time_percentiles(
+        self, ps: "tuple[float, ...]" = (50, 95, 99)
+    ) -> dict[str, float | None]:
+        """The serving-latency trio (p50/p95/p99 by default) off a single
+        sort of the window — what ``serving.metrics`` reports per
+        request."""
+        s = sorted(self._times)
+        return {f"p{p:g}": _percentile_sorted(s, p) for p in ps}
 
     def examples_per_sec(self) -> float | None:
         t = sum(self._times)

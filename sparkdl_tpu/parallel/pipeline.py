@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from sparkdl_tpu.compat import shard_map
 
 def stack_stage_params(per_stage_params: list[Any]) -> Any:
     """Stack per-stage param pytrees along a new leading (pp) dim."""
@@ -69,10 +70,11 @@ def _pipeline_local(
 
     # The loop body makes the carries device-varying (ppermute / axis_index
     # selects); mark the initial values as such for the VMA type system.
-    recv0 = lax.pcast(
-        jnp.zeros(out_shape.shape, out_shape.dtype), (axis_name,), to="varying"
-    )
-    out_buf = lax.pcast(out_buf, (axis_name,), to="varying")
+    # Older jax has no VMA typing (lax.pcast) and needs no declaration.
+    recv0 = jnp.zeros(out_shape.shape, out_shape.dtype)
+    if hasattr(lax, "pcast"):
+        recv0 = lax.pcast(recv0, (axis_name,), to="varying")
+        out_buf = lax.pcast(out_buf, (axis_name,), to="varying")
     (_, out_buf), _ = lax.scan(step, (recv0, out_buf), jnp.arange(total_steps))
     # Only the last stage holds real outputs; broadcast over the ring.
     out_buf = jnp.where(is_last, out_buf, jnp.zeros_like(out_buf))
@@ -105,7 +107,7 @@ def pipeline_apply(
         params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
         return _pipeline_local(stage_fn, params, x_mb, axis_name=axis_name)
 
-    out_mb = jax.shard_map(
+    out_mb = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis_name), P()),

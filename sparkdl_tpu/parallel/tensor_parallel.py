@@ -30,7 +30,21 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkdl_tpu.runtime.mesh import mesh_context
+
 Dtype = Any
+
+
+def _active_mesh():
+    """The mesh in scope, across jax versions: ``get_abstract_mesh`` when
+    the runtime has it (jax >= 0.5), else the thread-local physical mesh
+    (0.4.x spells the same 'which mesh am I under' question that way)."""
+    try:
+        return jax.sharding.get_abstract_mesh()
+    except AttributeError:  # jax < 0.5
+        from jax._src import mesh as mesh_lib
+
+        return mesh_lib.thread_resources.env.physical_mesh
 
 
 def constrain_dim(x: jax.Array, axis: str, dim: int = -1) -> jax.Array:
@@ -40,7 +54,7 @@ def constrain_dim(x: jax.Array, axis: str, dim: int = -1) -> jax.Array:
     leading expert dim. No-op outside a mesh context (single-device tests)
     or under shard_map over the axis (arrays are already per-device blocks);
     a mesh without the axis is a real error and propagates."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = _active_mesh()
     if mesh.empty:
         return x
     if axis not in mesh.axis_names:
@@ -168,5 +182,5 @@ def init_sharded(
         variables = module.init(r, *sample_inputs)
         return nn.meta.unbox(variables)
 
-    with jax.set_mesh(mesh):
+    with mesh_context(mesh):
         return jax.jit(_init, out_shardings=shardings)(rng)

@@ -14,7 +14,11 @@ from sparkdl_tpu.runtime.batching import (
     pad_to_bucket,
     rebatch,
 )
-from sparkdl_tpu.runtime.prefetch import pipelined_map, prefetch_to_device
+from sparkdl_tpu.runtime.prefetch import (
+    PrefetchIterator,
+    pipelined_map,
+    prefetch_to_device,
+)
 
 __all__ = [
     "AXIS_ORDER",
@@ -22,6 +26,7 @@ __all__ = [
     "FLOAT32",
     "MeshSpec",
     "PaddedBatch",
+    "PrefetchIterator",
     "batch_sharding",
     "data_parallel_mesh",
     "default_buckets",

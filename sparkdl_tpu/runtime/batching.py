@@ -31,8 +31,8 @@ class PaddedBatch:
     """A batch padded up to a bucket size.
 
     ``arrays`` leading dims equal ``bucket``; rows ``[n_valid:]`` are padding
-    (repeats of row 0 so they are numerically harmless) and must be dropped
-    from the output.
+    (repeats of row 0 so they are numerically harmless; zeros when the batch
+    is empty) and must be dropped from the output.
     """
 
     arrays: dict[str, np.ndarray]
@@ -53,7 +53,15 @@ def pick_bucket(n: int, buckets: Sequence[int]) -> int:
 def pad_to_bucket(arrays: dict[str, np.ndarray], buckets: Sequence[int]) -> PaddedBatch:
     n = next(iter(arrays.values())).shape[0]
     if n == 0:
-        raise ValueError("cannot pad an empty batch (no row to repeat)")
+        # Serving flush ticks can legitimately fire with zero queued rows:
+        # pad with zeros (there is no row 0 to repeat) up to the smallest
+        # bucket, n_valid=0 so unpad() drops everything.
+        bucket = min(buckets)
+        return PaddedBatch(
+            {k: np.zeros((bucket,) + a.shape[1:], a.dtype)
+             for k, a in arrays.items()},
+            0, bucket,
+        )
     bucket = pick_bucket(n, buckets)
     if bucket == n:
         return PaddedBatch(arrays, n, bucket)

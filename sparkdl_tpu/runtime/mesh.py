@@ -105,3 +105,18 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis]
+
+
+def mesh_context(mesh: Mesh):
+    """``with mesh_context(mesh):`` across jax versions.
+
+    jax >= 0.5 spells the ambient-mesh scope ``jax.set_mesh(mesh)``; on
+    0.4.x the Mesh object itself is the context manager that installs the
+    thread-local physical mesh (which ``with_sharding_constraint`` and
+    ``parallel.tensor_parallel.constrain_dim`` resolve axis names
+    against). One call site, either runtime.
+    """
+    set_mesh = getattr(jax, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    return mesh

@@ -20,65 +20,124 @@ U = TypeVar("U")
 _SENTINEL = object()
 
 
+class PrefetchIterator(Iterator[U]):
+    """Iterator over ``transfer(item)`` with a background producer thread.
+
+    Deterministic lifecycle for serving-style consumers that may abandon
+    the stream mid-flight (a cancelled request, an errored batch):
+    ``close()`` — also run by ``__del__``, exhaustion, and context-manager
+    exit — sets the stop event, drains the hand-off queue so a producer
+    blocked mid-put wakes up, and joins the thread. Unlike the previous
+    generator implementation, release does not depend on the *generator*
+    object being garbage-collected at the right moment.
+    """
+
+    def __init__(self, it: Iterable[T], size: int = 2,
+                 transfer: Callable[[T], U] | None = None):
+        if transfer is None:
+            transfer = jax.device_put
+        # maxsize=0 would make the queue unbounded (prefetch the whole
+        # stream); clamp so size<=0 means minimal, not infinite, buffering.
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, size))
+        self._err: list[BaseException] = []
+        self._stop = threading.Event()
+        self._done = False
+
+        # The producer must NOT close over ``self``: the running thread
+        # would then keep the iterator alive forever, so an abandoning
+        # consumer's drop never triggers __del__ and the thread leaks.
+        # Locals only — the thread pins just the queue/event/err cells.
+        q, stop, err = self._q, self._stop, self._err
+
+        def put(item) -> bool:
+            # Bounded put so an abandoned consumer releases the producer
+            # instead of leaking the thread and the device buffers queued
+            # behind it.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for item in it:
+                    if stop.is_set() or not put(transfer(item)):
+                        return
+            except BaseException as e:  # propagate into consumer
+                err.append(e)
+            finally:
+                put(_SENTINEL)
+
+        self._thread = threading.Thread(
+            target=producer, name="sparkdl-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def __iter__(self) -> "PrefetchIterator[U]":
+        return self
+
+    def __next__(self) -> U:
+        # Bounded gets so a close() from another thread (request
+        # cancellation) cannot strand us: once close() drains the queue
+        # the sentinel may never arrive, so re-check _done each beat.
+        while True:
+            if self._done:
+                raise StopIteration
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is _SENTINEL:
+                self.close()
+                if self._err:
+                    raise self._err[0]
+                raise StopIteration
+            return item
+
+    def close(self) -> None:
+        """Stop the producer and release queued buffers. Idempotent."""
+        self._done = True
+        self._stop.set()
+        # Drain so a producer blocked mid-put can observe stop and exit.
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if self._thread.is_alive():
+            self._thread.join(timeout=1.0)
+
+    def __enter__(self) -> "PrefetchIterator[U]":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # GC of an abandoned iterator must not leak the thread
+        try:
+            self.close()
+        except Exception:  # pragma: no cover - interpreter shutdown
+            pass
+
+
 def prefetch_to_device(
     it: Iterable[T],
     size: int = 2,
     transfer: Callable[[T], U] | None = None,
-) -> Iterator[U]:
+) -> PrefetchIterator[U]:
     """Run ``transfer`` (default jax.device_put) on a background thread,
     keeping ``size`` batches in flight ahead of the consumer.
 
     device_put is async — it returns as soon as the DMA is enqueued — so a
     depth-2 pipeline is enough to hide host→HBM transfer behind compute.
+    The returned :class:`PrefetchIterator` supports ``close()`` (also run
+    on GC and context-manager exit) so abandoning consumers never leak the
+    producer thread.
     """
-    if transfer is None:
-        transfer = jax.device_put
-    # maxsize=0 would make the queue unbounded (prefetch the whole stream);
-    # clamp so size<=0 means minimal, not infinite, buffering.
-    q: queue.Queue = queue.Queue(maxsize=max(1, size))
-    err: list[BaseException] = []
-    stop = threading.Event()
-
-    def put(item) -> bool:
-        # Bounded put so an abandoned consumer (generator closed early)
-        # releases the producer instead of leaking the thread and the
-        # device buffers queued behind it.
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def producer():
-        try:
-            for item in it:
-                if not put(transfer(item)):
-                    return
-        except BaseException as e:  # propagate into consumer
-            err.append(e)
-        finally:
-            put(_SENTINEL)
-
-    t = threading.Thread(target=producer, daemon=True)
-    t.start()
-    try:
-        while True:
-            item = q.get()
-            if item is _SENTINEL:
-                if err:
-                    raise err[0]
-                return
-            yield item
-    finally:
-        stop.set()
-        # Drain so a producer blocked mid-put can observe stop and exit.
-        while not q.empty():
-            try:
-                q.get_nowait()
-            except queue.Empty:  # pragma: no cover
-                break
+    return PrefetchIterator(it, size=size, transfer=transfer)
 
 
 def pipelined_map(

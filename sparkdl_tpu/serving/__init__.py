@@ -1,0 +1,53 @@
+"""Online serving: async admission, dynamic micro-batching, continuous
+GPT decode.
+
+Every other inference surface in this framework is batch-mode — a caller
+hands over a DataFrame and blocks until it drains. This package is the
+online half the ROADMAP's "serves heavy traffic" north star requires:
+requests arrive one at a time, asynchronously, and the engine coalesces
+them into the bucketed, jit-cached device batches the batch stack already
+compiles (tf.data's pipelining lesson applied to serving: decouple
+request arrival from device dispatch and the chip never starves).
+
+Three layers, separately testable:
+
+- :mod:`~sparkdl_tpu.serving.queue` — bounded admission with deadlines
+  and reject-with-error backpressure;
+- :mod:`~sparkdl_tpu.serving.microbatcher` /
+  :mod:`~sparkdl_tpu.serving.engine` — max-wait/max-batch dispatch into a
+  :class:`~sparkdl_tpu.transformers._inference.BatchedRunner` (dp-sharded
+  on multi-chip hosts), per-request error isolation, graceful drain;
+- :mod:`~sparkdl_tpu.serving.continuous` — continuous batching for GPT
+  generation over a per-slot KV cache: finished rows free their slot
+  mid-stream, new prompts join the in-flight decode batch, greedy tokens
+  stay identical to the unbatched decode.
+
+Observability (:mod:`~sparkdl_tpu.serving.metrics`): queue depth, batch
+occupancy %, admission rejects, and p50/p95/p99 request latency via the
+shared :func:`~sparkdl_tpu.observability.metrics.percentile` helpers.
+"""
+
+from sparkdl_tpu.serving.continuous import ContinuousGPTEngine, GenRequest
+from sparkdl_tpu.serving.engine import ServingEngine
+from sparkdl_tpu.serving.metrics import ServingMetrics
+from sparkdl_tpu.serving.microbatcher import MicroBatcher
+from sparkdl_tpu.serving.queue import (
+    DeadlineExceededError,
+    EngineClosedError,
+    QueueFullError,
+    Request,
+    RequestQueue,
+)
+
+__all__ = [
+    "ContinuousGPTEngine",
+    "DeadlineExceededError",
+    "EngineClosedError",
+    "GenRequest",
+    "MicroBatcher",
+    "QueueFullError",
+    "Request",
+    "RequestQueue",
+    "ServingEngine",
+    "ServingMetrics",
+]

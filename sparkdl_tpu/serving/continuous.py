@@ -1,0 +1,375 @@
+"""Continuous batching for GPT decode: rows join and leave mid-stream.
+
+The lockstep ``generate`` path (models/gpt.py) starts a batch together
+and ends it together, so one long row holds every slot hostage and new
+arrivals wait for the whole batch to finish — fatal for online serving.
+This engine keeps ONE persistent decode batch of ``n_slots`` rows over a
+per-slot KV cache (``init_cache(per_slot=True)``: ``idx`` per row):
+
+- a finished row frees its slot immediately;
+- a newly admitted prompt is prefilled ALONE (batch-1, bucketed prompt
+  length, the jit-cached left-padded ragged path) and its K/V row is
+  scattered into the free slot — the in-flight neighbors never notice;
+- every engine tick advances all live rows one token in a single jitted
+  step whose per-row causal mask lets each row decode at its own depth.
+
+Token identity: greedy tokens of every request are IDENTICAL to its
+unbatched ``generate`` decode (tests/serving/test_continuous_gpt.py) —
+batching is a scheduling decision, never a quality decision.
+
+Decode is greedy (temperature 0), the deterministic serving default;
+sampled decode stays on the lockstep ``DeepTextGenerator`` path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Optional
+
+import numpy as np
+
+from sparkdl_tpu.serving.metrics import ServingMetrics
+from sparkdl_tpu.serving.queue import (
+    DeadlineExceededError,
+    EngineClosedError,
+    Request,
+    RequestQueue,
+)
+
+
+@dataclasses.dataclass
+class GenRequest:
+    """One generation request: prompt token ids + token budget."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """Host-side state of one occupied slot (the left-pad count lives in
+    the engine's ``_start`` array the decode step consumes)."""
+
+    req: Request
+    produced: list[int]
+    max_new: int
+
+
+class ContinuousGPTEngine:
+    """Async continuous-batching GPT server.
+
+    ``submit(prompt_ids, max_new_tokens)`` returns a Future of the
+    generated ids (prompt not included). Admission control is two-layer:
+    queue depth (QueueFullError) and cache capacity — a request whose
+    bucketed prompt + budget cannot fit ``max_len`` columns is rejected
+    at submit, loudly, because its cache writes would silently drop.
+
+    ``auto_start=False`` exposes :meth:`tick` for deterministic
+    single-step tests; the default runs the loop on a daemon thread.
+    """
+
+    def __init__(self, config, variables, *, n_slots: int = 8,
+                 max_len: int = 512, max_queue_depth: int = 256,
+                 eos_id: Optional[int] = None,
+                 idle_wait_s: float = 0.005,
+                 metrics: ServingMetrics | None = None,
+                 auto_start: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.models.gpt import (
+            GPTLMHeadModel,
+            init_cache,
+        )
+        from sparkdl_tpu.runtime.batching import default_buckets
+
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if (config.positions == "learned"
+                and max_len > config.max_seq_len):
+            raise ValueError(
+                f"max_len {max_len} exceeds the learned position table "
+                f"(max_seq_len={config.max_seq_len})"
+            )
+        self.config = config
+        self.variables = variables
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.eos_id = eos_id
+        self.idle_wait_s = idle_wait_s
+        self.queue = RequestQueue(max_depth=max_queue_depth)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._model = GPTLMHeadModel(config)
+        self._len_buckets = default_buckets(max_len, min_bucket=8)
+        self._inflight: dict[int, _InFlight] = {}
+        self._cache = init_cache(config, n_slots, max_len, per_slot=True)
+        self._start = np.zeros((n_slots,), np.int32)
+        self._last_tok = np.zeros((n_slots,), np.int32)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        model = self._model
+
+        @jax.jit
+        def _prefill(variables, ids, mask):
+            # batch-1 left-padded prefill in a fresh scalar-idx cache of
+            # the SHARED buffer width, so columns line up at scatter time.
+            # jit's shape cache gives one compile per prompt-length bucket.
+            lp = ids.shape[1]
+            cache = init_cache(config, 1, max_len)
+            positions = jnp.clip(jnp.cumsum(mask, axis=1) - 1, 0)
+            key_valid = jnp.concatenate(
+                [mask.astype(bool),
+                 jnp.ones((1, max_len - lp), bool)], axis=1,
+            )
+            logits, cache = model.apply(
+                variables, ids, cache=cache, positions=positions,
+                attention_mask=key_valid,
+            )
+            return jnp.argmax(logits[:, -1], axis=-1), cache
+
+        # donate the cache through scatter and step: the engine always
+        # discards the old version, and without donation every token
+        # would materialize a second full [layers, S, max_len, H, D]
+        # buffer (2x HBM peak + a copy per token at serving sizes)
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _scatter(cache, row, slot):
+            # install a prefilled row into slot (traced index: one compile)
+            return {
+                "k": jax.lax.dynamic_update_slice_in_dim(
+                    cache["k"], row["k"], slot, axis=1),
+                "v": jax.lax.dynamic_update_slice_in_dim(
+                    cache["v"], row["v"], slot, axis=1),
+                "idx": cache["idx"].at[slot].set(
+                    row["idx"].astype(jnp.int32)),
+            }
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _step(variables, cache, tok, start):
+            # one token for every slot; the per-slot cache gives each row
+            # its own causal depth, `start` masks its left-pad columns,
+            # and RoPE/learned positions count real tokens only
+            positions = (cache["idx"] - start)[:, None]
+            key_valid = jnp.arange(max_len)[None, :] >= start[:, None]
+            logits, cache = model.apply(
+                variables, tok[:, None], cache=cache, positions=positions,
+                attention_mask=key_valid,
+            )
+            return jnp.argmax(logits[:, -1], axis=-1), cache
+
+        self._prefill_fn = _prefill
+        self._scatter_fn = _scatter
+        self._step_fn = _step
+        if auto_start:
+            self.start()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int, *,
+               timeout_s: float | None = None) -> Future:
+        """Admit one prompt; Future resolves to the generated ids
+        (np.int32 array, ``<= max_new_tokens`` long — shorter on eos)."""
+        from sparkdl_tpu.runtime.batching import pick_bucket
+
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError(
+                f"prompt must be a non-empty 1-D id array, got shape "
+                f"{prompt.shape}"
+            )
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        lp = pick_bucket(len(prompt), self._len_buckets)
+        if lp + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt bucket {lp} + max_new_tokens {max_new_tokens} "
+                f"exceeds cache max_len {self.max_len}: raise max_len or "
+                "shorten the request"
+            )
+        return self.queue.submit(
+            GenRequest(prompt, max_new_tokens), timeout_s=timeout_s
+        )
+
+    # -- engine loop ---------------------------------------------------------
+    def start(self) -> "ContinuousGPTEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="sparkdl-continuous-gpt", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self, *, drain: bool = True,
+              timeout_s: float | None = 30.0) -> None:
+        """Stop. ``drain=True`` finishes every admitted request (queued
+        and in-flight) first; ``drain=False`` fails them now."""
+        self.queue.close()
+        if not drain:
+            self.queue.fail_pending()
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+        elif drain:  # manual-tick mode: drain inline
+            while self.queue.depth > 0 or self._inflight:
+                self.tick()
+        self._stop.set()
+        # join timeout or a crashed loop may leave requests queued: no
+        # Future may ever be left unresolved
+        self.queue.fail_pending()
+        with self._lock:
+            self._fail_inflight(EngineClosedError("engine shut down"))
+
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                did_work = self.tick()
+                if self.queue.closed and not did_work:
+                    with self._lock:
+                        if self.queue.depth == 0 and not self._inflight:
+                            return  # graceful drain complete
+            # non-graceful: surviving inflight failed by close()
+        except BaseException as e:
+            # a crashed loop (device OOM, XLA error) must not strand
+            # callers blocked on their Futures
+            exc = (e if isinstance(e, Exception)
+                   else EngineClosedError(f"engine loop died: {e!r}"))
+            self.queue.close()
+            self.queue.fail_pending(exc)
+            with self._lock:
+                self._fail_inflight(exc)
+            raise
+
+    # -- one scheduling quantum ---------------------------------------------
+    def tick(self) -> bool:
+        """Admit into free slots, advance every live row one token,
+        retire finished rows. Returns True if any work happened (False =
+        idle tick). Thread-safe; the background loop is just
+        ``while True: tick()``."""
+        with self._lock:
+            now = time.monotonic()
+            self._expire_inflight(now)
+            free = [s for s in range(self.n_slots)
+                    if s not in self._inflight]
+            if free:
+                wait = 0.0 if self._inflight else self.idle_wait_s
+                for req in self.queue.take(len(free), wait):
+                    slot = free.pop(0)
+                    try:
+                        self._admit(slot, req)
+                    except Exception as e:
+                        # take() already moved this Future to RUNNING, so
+                        # nobody else can resolve it: a failed admission
+                        # (prefill OOM, compile error) is THIS request's
+                        # error, never the engine's — the slot stays free
+                        # and the loop keeps serving
+                        free.insert(0, slot)
+                        if not req.future.done():
+                            req.future.set_exception(e)
+                            self.metrics.record_request(
+                                now - req.enqueued, ok=False
+                            )
+            else:
+                self.queue.sweep_expired()  # deadlines don't wait for slots
+            if not self._inflight:
+                return False
+            self._decode_step()
+            return True
+
+    def _admit(self, slot: int, req: Request) -> None:
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.runtime.batching import pick_bucket
+
+        gen: GenRequest = req.payload
+        lp = pick_bucket(len(gen.prompt), self._len_buckets)
+        ids = np.zeros((1, lp), np.int32)
+        mask = np.zeros((1, lp), np.int32)
+        ids[0, lp - len(gen.prompt):] = gen.prompt
+        mask[0, lp - len(gen.prompt):] = 1
+        tok, row = self._prefill_fn(
+            self.variables, jnp.asarray(ids), jnp.asarray(mask)
+        )
+        self._cache = self._scatter_fn(
+            self._cache, row, jnp.asarray(slot, jnp.int32)
+        )
+        first = int(tok[0])
+        self._start[slot] = lp - len(gen.prompt)
+        self._last_tok[slot] = first
+        flight = _InFlight(req, [first], gen.max_new_tokens)
+        self._inflight[slot] = flight
+        if self._is_done(flight):  # max_new_tokens=1, or instant eos
+            self._complete(slot)
+
+    def _decode_step(self) -> None:
+        import jax.numpy as jnp
+
+        tok, self._cache = self._step_fn(
+            self.variables, self._cache,
+            jnp.asarray(self._last_tok), jnp.asarray(self._start),
+        )
+        tok = np.asarray(tok)
+        self.metrics.record_batch(len(self._inflight), self.n_slots)
+        for slot in list(self._inflight):
+            flight = self._inflight[slot]
+            flight.produced.append(int(tok[slot]))
+            self._last_tok[slot] = tok[slot]
+            if self._is_done(flight):
+                self._complete(slot)
+
+    def _is_done(self, flight: _InFlight) -> bool:
+        return (len(flight.produced) >= flight.max_new
+                or (self.eos_id is not None
+                    and flight.produced[-1] == self.eos_id))
+
+    def _complete(self, slot: int) -> None:
+        flight = self._inflight.pop(slot)
+        latency = time.monotonic() - flight.req.enqueued
+        flight.req.future.set_result(
+            np.asarray(flight.produced, np.int32)
+        )
+        self.metrics.record_request(latency, ok=True)
+
+    def _expire_inflight(self, now: float) -> None:
+        for slot in list(self._inflight):
+            flight = self._inflight[slot]
+            if flight.req.expired(now):
+                self._inflight.pop(slot)
+                flight.req.future.set_exception(DeadlineExceededError(
+                    "deadline exceeded mid-decode "
+                    f"({len(flight.produced)}/{flight.max_new} tokens)"
+                ))
+                self.metrics.record_request(
+                    now - flight.req.enqueued, ok=False
+                )
+
+    def _fail_inflight(self, exc: Exception) -> None:
+        for slot in list(self._inflight):
+            flight = self._inflight.pop(slot)
+            if not flight.req.future.done():
+                flight.req.future.set_exception(exc)
+                self.metrics.record_request(
+                    time.monotonic() - flight.req.enqueued, ok=False
+                )
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def active_slots(self) -> int:
+        return len(self._inflight)
+
+    def snapshot(self) -> dict[str, Any]:
+        out = self.metrics.snapshot(self.queue)
+        out["active_slots"] = self.active_slots
+        out["n_slots"] = self.n_slots
+        return out
+
+    def __enter__(self) -> "ContinuousGPTEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=exc == (None, None, None))

@@ -1,0 +1,72 @@
+"""ServingEngine — the async front door over queue + micro-batcher.
+
+One object to construct, one method to call::
+
+    engine = ServingEngine(
+        BatchedRunner(jitted_apply, batch_size=64), max_wait_s=0.004
+    )
+    fut = engine.submit({"x": row})          # returns immediately
+    y = fut.result(timeout=1.0)              # one output row
+
+Requests coalesce into bucketed device batches (dp-sharded on multi-chip
+hosts — whatever the wrapped BatchedRunner compiled); overload rejects at
+admission (QueueFullError), deadlines cancel mid-queue
+(DeadlineExceededError), and ``close(drain=True)`` serves every admitted
+request before stopping.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from typing import Any, Callable
+
+import numpy as np
+
+from sparkdl_tpu.serving.metrics import ServingMetrics
+from sparkdl_tpu.serving.microbatcher import MicroBatcher
+from sparkdl_tpu.serving.queue import RequestQueue
+from sparkdl_tpu.transformers._inference import BatchedRunner
+
+
+class ServingEngine:
+    """Online micro-batching inference over a :class:`BatchedRunner`.
+
+    ``max_wait_s`` bounds the extra latency the FIRST request of a batch
+    pays to pick up riders; ``max_queue_depth`` bounds host memory and
+    turns overload into fast rejects instead of unbounded tail latency.
+    """
+
+    def __init__(self, runner: BatchedRunner, *,
+                 max_queue_depth: int = 256,
+                 max_wait_s: float = 0.005,
+                 extract: Callable[[Any], dict[str, np.ndarray]] | None = None,
+                 metrics: ServingMetrics | None = None):
+        self.queue = RequestQueue(max_depth=max_queue_depth)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.batcher = MicroBatcher(
+            self.queue, runner, max_wait_s=max_wait_s, extract=extract,
+            metrics=self.metrics,
+        ).start()
+
+    def submit(self, payload: Any, *,
+               timeout_s: float | None = None) -> Future:
+        """Admit one request (a feature dict of per-row arrays, or
+        whatever ``extract`` eats). Returns a Future resolving to the
+        output row; raises QueueFullError / EngineClosedError at the
+        door."""
+        return self.queue.submit(payload, timeout_s=timeout_s)
+
+    def close(self, *, drain: bool = True,
+              timeout_s: float | None = 30.0) -> None:
+        self.batcher.shutdown(drain=drain, timeout_s=timeout_s)
+
+    def snapshot(self) -> dict:
+        """Operator metrics: queue depth, occupancy, latency p50/p95/p99,
+        admission counters."""
+        return self.metrics.snapshot(self.queue)
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=exc == (None, None, None))

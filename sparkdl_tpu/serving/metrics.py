@@ -1,0 +1,79 @@
+"""Serving observability: queue depth, batch occupancy, latency tails.
+
+Built on :mod:`sparkdl_tpu.observability.metrics` — per-request latency
+rides a :class:`StepMeter` window so the p50/p95/p99 helpers are the SAME
+code that meters training steps (one percentile implementation in the
+whole stack), and counters mirror the queue's admission bookkeeping.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from sparkdl_tpu.observability.metrics import StepMeter
+
+
+class ServingMetrics:
+    """Thread-safe counters + windowed latency/occupancy for one engine.
+
+    ``snapshot()`` is the structured dict an operator scrapes: admission
+    (submitted/rejected/expired/cancelled, straight off the queue's own
+    counters), outcomes (completed/failed), queue depth, mean
+    batch-occupancy %, dispatch count, and request latency p50/p95/p99
+    (seconds, submit -> result).
+    """
+
+    def __init__(self, window: int = 1024):
+        self._lock = threading.Lock()
+        # n_chips=1: latency is per request, not per chip; warmup 0 —
+        # serving must count the compile-paying first requests too.
+        self._latency = StepMeter(n_chips=1, window=window, warmup_steps=0)
+        self._occupancy = StepMeter(n_chips=1, window=window, warmup_steps=0)
+        self.completed = 0
+        self.failed = 0
+        self.batches = 0
+
+    def record_request(self, latency_s: float, *, ok: bool) -> None:
+        with self._lock:
+            self._latency.record(latency_s, examples=1)
+            if ok:
+                self.completed += 1
+            else:
+                self.failed += 1
+
+    def record_batch(self, n_valid: int, capacity: int) -> None:
+        """One device dispatch: ``n_valid`` live rows of ``capacity``
+        (bucket size or slot count) — occupancy is what dynamic batching
+        is buying over batch-of-1."""
+        with self._lock:
+            self.batches += 1
+            if capacity > 0:
+                self._occupancy.record(100.0 * n_valid / capacity,
+                                       examples=n_valid)
+
+    def latency_percentiles(self) -> dict[str, float | None]:
+        with self._lock:
+            return self._latency.step_time_percentiles((50, 95, 99))
+
+    def snapshot(self, queue=None) -> dict[str, Any]:
+        """Point-in-time metrics dict; pass the engine's RequestQueue to
+        include its depth and admission counters."""
+        with self._lock:
+            out: dict[str, Any] = {
+                "completed": self.completed,
+                "failed": self.failed,
+                "batches": self.batches,
+                "batch_occupancy_pct": self._occupancy.mean_step_time(),
+                "latency_s": self._latency.step_time_percentiles((50, 95, 99)),
+                "latency_mean_s": self._latency.mean_step_time(),
+            }
+        if queue is not None:
+            out.update(
+                queue_depth=queue.depth,
+                submitted=queue.submitted,
+                rejected=queue.rejected,
+                expired=queue.expired,
+                cancelled=queue.cancelled,
+            )
+        return out

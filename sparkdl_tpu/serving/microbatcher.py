@@ -1,0 +1,169 @@
+"""Dynamic micro-batching dispatch loop over a BatchedRunner.
+
+The chip-saturation half of the serving engine: individual requests (one
+row each) coalesce into the bucketed, jit-cached device batches the batch
+pipeline already compiles (``transformers/_inference.BatchedRunner`` —
+including its automatic dp sharding on multi-chip hosts). Policy is the
+classic max-wait/max-batch: the first request in an empty queue waits at
+most ``max_wait_s`` before dispatch; every request that arrives in that
+window rides the same device program for free.
+
+Robustness contract: a bad request degrades to ITS error, never the
+batch's. Extraction failures (shared :func:`try_extract` convention) fail
+per request before stacking; a dispatch failure of a multi-row batch
+falls back to per-row dispatch so healthy neighbors of a poison row still
+get results.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from sparkdl_tpu.serving.metrics import ServingMetrics
+from sparkdl_tpu.serving.queue import Request, RequestQueue
+from sparkdl_tpu.transformers._inference import BatchedRunner, try_extract
+
+_log = logging.getLogger(__name__)
+
+
+class MicroBatcher:
+    """Drains a :class:`RequestQueue` into ``runner.run_batch`` dispatches.
+
+    ``extract`` (optional) maps a request payload to the feature dict the
+    runner eats — same role as the partition path's extract, same
+    per-row-error semantics. Without it, payloads must already be feature
+    dicts of per-row arrays (no batch dim; the batcher stacks).
+    """
+
+    def __init__(self, queue: RequestQueue, runner: BatchedRunner, *,
+                 max_wait_s: float = 0.005,
+                 extract: Callable[[Any], dict[str, np.ndarray]] | None = None,
+                 metrics: ServingMetrics | None = None):
+        self.queue = queue
+        self.runner = runner
+        self.max_wait_s = max_wait_s
+        self.extract = extract
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        self._thread = threading.Thread(
+            target=self._loop, name="sparkdl-microbatcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self, *, drain: bool = True,
+                 timeout_s: float | None = 30.0) -> None:
+        """Stop the loop. ``drain=True`` (graceful): close admission,
+        serve everything already queued, then stop. ``drain=False``: fail
+        queued requests with EngineClosedError and stop now."""
+        self.queue.close()
+        if not drain:
+            self.queue.fail_pending()
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+            if self._thread.is_alive():  # pragma: no cover - watchdog only
+                _log.warning("micro-batcher did not stop in %ss", timeout_s)
+        elif drain:  # never started: drain inline so no future is stranded
+            while True:
+                reqs = self.queue.take(self.runner.batch_size, 0.0)
+                if not reqs:
+                    break
+                self._dispatch(reqs)
+        self._stop.set()
+        # a timed-out join or crashed loop may leave queued requests
+        # behind: no Future may ever be left unresolved
+        self.queue.fail_pending()
+
+    # -- dispatch ------------------------------------------------------------
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                reqs = self.queue.take(self.runner.batch_size,
+                                       self.max_wait_s)
+                if not reqs:
+                    if self.queue.closed and self.queue.depth == 0:
+                        break  # graceful drain complete
+                    continue
+                self._dispatch(reqs)
+        except BaseException as e:
+            # _dispatch contains per-batch error handling; anything that
+            # escapes is fatal — fail the queue rather than strand callers
+            exc = (e if isinstance(e, Exception)
+                   else RuntimeError(f"micro-batcher loop died: {e!r}"))
+            self.queue.close()
+            self.queue.fail_pending(exc)
+            raise
+
+    def _dispatch(self, reqs: list[Request]) -> None:
+        feeds: list[dict[str, np.ndarray]] = []
+        live: list[Request] = []
+        for req in reqs:
+            feed, err = (try_extract(self.extract, req.payload)
+                         if self.extract is not None
+                         else (req.payload, None))
+            if err is not None:
+                self._finish(req, error=err)
+                continue
+            feeds.append(feed)
+            live.append(req)
+        if not live:
+            return
+        try:
+            outs = self._run(feeds)
+        except Exception as e:
+            if len(live) == 1:
+                self._finish(live[0], error=e)
+                return
+            # poison-row fallback: one bad row must not take down its
+            # batch-mates — retry each row alone, only the culprit errors
+            _log.warning(
+                "batch of %d failed; retrying per-row", len(live),
+                exc_info=True,
+            )
+            for req, feed in zip(live, feeds):
+                # each retry is a real device dispatch: count it, at its
+                # honest 1-row occupancy, so a poison-row storm shows up
+                # in the metrics instead of hiding behind them
+                self.metrics.record_batch(1, self.runner.batch_size)
+                try:
+                    out = self._run([feed])
+                    self._finish(req, result=_row(out, 0))
+                except Exception as row_e:
+                    self._finish(req, error=row_e)
+            return
+        self.metrics.record_batch(len(live), self.runner.batch_size)
+        for i, req in enumerate(live):
+            self._finish(req, result=_row(outs, i))
+
+    def _run(self, feeds: list[dict[str, np.ndarray]]):
+        keys = feeds[0].keys()
+        if any(f.keys() != keys for f in feeds):
+            raise ValueError("requests disagree on feature keys")
+        arrays = {k: np.stack([np.asarray(f[k]) for f in feeds]) for k in keys}
+        return self.runner.run_batch(arrays)
+
+    def _finish(self, req: Request, *, result: Any = None,
+                error: Exception | None = None) -> None:
+        latency = time.monotonic() - req.enqueued
+        if error is not None:
+            req.future.set_exception(error)
+        else:
+            req.future.set_result(result)
+        self.metrics.record_request(latency, ok=error is None)
+
+
+def _row(out, i: int):
+    """Row ``i`` of a run_batch output (array or tuple of arrays)."""
+    if isinstance(out, tuple):
+        return tuple(o[i] for o in out)
+    return out[i]

@@ -1,0 +1,189 @@
+"""Bounded async request queue: admission control, deadlines, backpressure.
+
+The front door of the online serving engine (ROADMAP: "serves heavy
+traffic"): callers submit individual requests and get a
+``concurrent.futures.Future`` back immediately; the dispatch loop drains
+the queue into device batches. Admission is bounded — past ``max_depth``
+the submit *raises* (:class:`QueueFullError`) instead of buffering
+unboundedly, the reject-with-error backpressure that keeps tail latency
+honest under overload (the tf.data lesson: queue growth only moves the
+stall, it never removes it). Every request may carry a deadline; expired
+requests fail with :class:`DeadlineExceededError` at the next sweep
+instead of wasting a batch slot.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any
+
+
+class QueueFullError(RuntimeError):
+    """Admission reject: queue at max depth (backpressure — retry later)."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline passed before a result was produced."""
+
+
+class EngineClosedError(RuntimeError):
+    """Submit after close(): the engine is draining or stopped."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued unit of work. ``deadline`` is absolute ``time.monotonic``
+    seconds (None = no deadline); ``enqueued`` stamps queue-wait metrics."""
+
+    payload: Any
+    future: Future
+    deadline: float | None
+    enqueued: float
+
+    def expired(self, now: float | None = None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                >= self.deadline)
+
+    def fail_expired(self) -> None:
+        # a future the caller already cancelled cannot take an exception
+        if self.future.set_running_or_notify_cancel():
+            self.future.set_exception(DeadlineExceededError(
+                f"deadline exceeded after "
+                f"{time.monotonic() - self.enqueued:.3f}s in queue"
+            ))
+
+
+class RequestQueue:
+    """Thread-safe bounded FIFO of :class:`Request`.
+
+    ``submit`` is the producer side (any number of caller threads);
+    ``take`` is the consumer side (the dispatch loop). Expired requests
+    are swept — failed with DeadlineExceededError, never handed to the
+    batcher — on every take, and on submit when at capacity (so a full
+    queue of dead requests does not reject live traffic).
+    """
+
+    def __init__(self, max_depth: int = 256):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = max_depth
+        self._dq: collections.deque[Request] = collections.deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        #: monotonically increasing counters (read under no lock: ints)
+        self.submitted = 0
+        self.rejected = 0
+        self.expired = 0
+        self.cancelled = 0
+
+    @property
+    def depth(self) -> int:
+        return len(self._dq)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def submit(self, payload: Any, *,
+               timeout_s: float | None = None) -> Future:
+        """Enqueue; returns the request's Future. Raises
+        :class:`QueueFullError` at capacity (after sweeping expired
+        entries) and :class:`EngineClosedError` after close()."""
+        now = time.monotonic()
+        deadline = now + timeout_s if timeout_s is not None else None
+        with self._cv:
+            if self._closed:
+                raise EngineClosedError("queue is closed to new requests")
+            if len(self._dq) >= self.max_depth:
+                self._sweep_expired_locked(now)
+            if len(self._dq) >= self.max_depth:
+                self.rejected += 1
+                raise QueueFullError(
+                    f"queue at max depth {self.max_depth}; retry with "
+                    "backoff or raise capacity"
+                )
+            fut: Future = Future()
+            self._dq.append(Request(payload, fut, deadline, now))
+            self.submitted += 1
+            self._cv.notify()
+            return fut
+
+    def take(self, max_n: int, max_wait_s: float) -> list[Request]:
+        """Dispatch-side drain: block up to ``max_wait_s`` for the first
+        live request, then return every immediately-available live request
+        up to ``max_n`` (the micro-batching max-wait/max-batch policy —
+        the first arrival pays at most ``max_wait_s`` extra latency,
+        followers ride along for free). Returns [] on timeout or close.
+
+        Requests whose Future was cancelled by the caller are dropped;
+        expired requests are failed and skipped.
+        """
+        if max_n < 1:
+            return []
+        end = time.monotonic() + max_wait_s
+        out: list[Request] = []
+        with self._cv:
+            while not self._dq and not self._closed:
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cv.wait(remaining)
+            now = time.monotonic()
+            while self._dq and len(out) < max_n:
+                req = self._dq.popleft()
+                if req.expired(now):
+                    self.expired += 1
+                    req.fail_expired()
+                    continue
+                # a caller that cancelled its Future no longer wants the
+                # result; set_running_or_notify_cancel is the handshake
+                if not req.future.set_running_or_notify_cancel():
+                    self.cancelled += 1
+                    continue
+                out.append(req)
+        return out
+
+    def close(self) -> None:
+        """Stop admission (submit raises EngineClosedError); queued
+        requests stay takeable so the engine can drain gracefully."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def fail_pending(self, exc: BaseException | None = None) -> int:
+        """Fail every queued request (non-graceful shutdown). Returns the
+        number failed."""
+        if exc is None:
+            exc = EngineClosedError("engine shut down before dispatch")
+        n = 0
+        with self._cv:
+            while self._dq:
+                req = self._dq.popleft()
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(exc)
+                else:
+                    self.cancelled += 1
+                n += 1
+        return n
+
+    def sweep_expired(self) -> None:
+        """Fail every expired queued request now. take() sweeps anyway;
+        engines call this when they are NOT taking (all slots busy) so a
+        dead request's caller hears promptly instead of at the next free
+        slot."""
+        with self._cv:
+            self._sweep_expired_locked(time.monotonic())
+
+    def _sweep_expired_locked(self, now: float) -> None:
+        live = [r for r in self._dq if not r.expired(now)]
+        for r in self._dq:
+            if r.expired(now):
+                self.expired += 1
+                r.fail_expired()
+        self._dq.clear()
+        self._dq.extend(live)

@@ -20,7 +20,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkdl_tpu.runtime.mesh import data_parallel_mesh
+from sparkdl_tpu.runtime.mesh import data_parallel_mesh, mesh_context
 
 
 @flax.struct.dataclass
@@ -117,11 +117,14 @@ def finetune_classifier(
             save_interval_steps=checkpoint_every,
         )
     try:
-        with jax.set_mesh(mesh):
+        with mesh_context(mesh):
             state = TrainState(
                 params=jax.device_put(params, repl),
                 opt_state=jax.device_put(tx.init(params), repl),
-                step=jnp.zeros((), jnp.int32),
+                # commit the scalar too: an uncommitted device-0 step next
+                # to 8-device params is a mixed-device error under jit on
+                # runtimes without an ambient-mesh auto-commit
+                step=jax.device_put(jnp.zeros((), jnp.int32), repl),
             )
             resume_step = 0
             if ckpt is not None and ckpt.latest_step() is not None:

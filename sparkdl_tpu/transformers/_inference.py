@@ -16,7 +16,11 @@ from typing import Any, Callable, Iterator
 import jax
 import numpy as np
 
-from sparkdl_tpu.runtime.batching import default_buckets, rebatch
+from sparkdl_tpu.runtime.batching import (
+    default_buckets,
+    pad_to_bucket,
+    rebatch,
+)
 from sparkdl_tpu.runtime.prefetch import prefetch_to_device
 
 
@@ -164,6 +168,24 @@ class BatchedRunner:
             chained(), size=self.prefetch, transfer=self._transfer
         )
 
+    def run_batch(self, arrays: dict[str, np.ndarray]):
+        """One-shot dispatch for the online serving path: pad the stacked
+        batch to its bucket, stage it (dp-sharded on multi-chip hosts —
+        the same ``_transfer`` the streaming path uses), run the SAME
+        jitted program the batch path compiled, and unpad.
+
+        Returns the output array [n, ...] (or a tuple of arrays for
+        multi-output apply_fns). An empty input (a serving flush tick)
+        still runs the smallest-bucket program — pad_to_bucket zero-fills
+        it — so the outputs keep their real dtypes and feature shapes,
+        just with 0 rows.
+        """
+        padded = pad_to_bucket(arrays, self._buckets)
+        out = self._jitted(self._transfer(padded.arrays))
+        if isinstance(out, (tuple, list)):
+            return tuple(np.asarray(o)[: padded.n_valid] for o in out)
+        return np.asarray(out)[: padded.n_valid]
+
     def _transfer(self, arrays: dict[str, np.ndarray]):
         if self._sharding is not None:
             # committed sharded inputs: one shard per local chip, and jit
@@ -190,6 +212,18 @@ def cached_graph_runner(graph, key, make_apply_fn: Callable[[], Callable],
             make_apply_fn(), batch_size=batch_size, ragged_rows=ragged_rows
         )
     return per_graph[key]
+
+
+def try_extract(extract: Callable[[Any], dict[str, np.ndarray]],
+                row: Any) -> "tuple[dict[str, np.ndarray] | None, Exception | None]":
+    """Run ``extract`` on one row, capturing the error instead of raising —
+    the single bad-row convention shared by the batch partition path and
+    the online micro-batcher: a row that cannot be featurized degrades to
+    a per-row error and never poisons its batch."""
+    try:
+        return extract(row), None
+    except Exception as e:
+        return None, e
 
 
 def run_partition_with_passthrough(
@@ -219,11 +253,9 @@ def run_partition_with_passthrough(
     feeds: list[dict[str, np.ndarray] | None] = []
     first_error: Exception | None = None
     for r in rows:
-        try:
-            feeds.append(extract(r))
-        except Exception as e:
-            first_error = first_error or e
-            feeds.append(None)
+        feed, err = try_extract(extract, r)
+        first_error = first_error or err
+        feeds.append(feed)
     valid = [f for f in feeds if f is not None]
     if rows and not valid and first_error is not None:
         logging.getLogger(__name__).warning(

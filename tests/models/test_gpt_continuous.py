@@ -1,0 +1,142 @@
+"""Per-slot KV cache oracle: the continuous-batching building block.
+
+Contract (models/gpt.py init_cache per_slot=True): a cache whose ``idx``
+is per-row decodes every row at its own depth, and each row's tokens are
+identical to continuing that row alone in its own scalar-idx cache — the
+property that lets the serving engine admit/retire rows mid-stream
+without perturbing their neighbors.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.models.gpt import (
+    GPTConfig,
+    GPTLMHeadModel,
+    init_cache,
+)
+
+MAX_LEN = 32
+
+
+def _prefill_single(model, variables, prompt, max_len=MAX_LEN):
+    """Batch-1 scalar-idx prefill; returns (first greedy token, cache)."""
+    ids = jnp.asarray([prompt], jnp.int32)
+    cache = init_cache(model.config, 1, max_len)
+    logits, cache = model.apply(variables, ids, cache=cache)
+    return int(jnp.argmax(logits[0, -1])), cache
+
+
+def _decode_single(model, variables, cache, tok, steps):
+    """Reference: greedy scalar-idx decode, one row alone."""
+    toks = []
+    for _ in range(steps):
+        toks.append(tok)
+        logits, cache = model.apply(
+            variables, jnp.asarray([[tok]], jnp.int32), cache=cache
+        )
+        tok = int(jnp.argmax(logits[0, -1]))
+    return toks
+
+
+@pytest.mark.parametrize("positions", ["rope", "learned"])
+def test_per_slot_decode_matches_single_row(positions):
+    cfg = GPTConfig.tiny(positions=positions)
+    model = GPTLMHeadModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    prompts = [[5, 3, 9, 2, 7], [1, 4], [6, 8, 6]]
+    steps = 5
+
+    # build the shared per-slot cache from independent batch-1 prefills
+    shared = init_cache(cfg, len(prompts), MAX_LEN, per_slot=True)
+    toks = []
+    for s, p in enumerate(prompts):
+        tok, single = _prefill_single(model, variables, p)
+        shared["k"] = shared["k"].at[:, s].set(single["k"][:, 0])
+        shared["v"] = shared["v"].at[:, s].set(single["v"][:, 0])
+        shared["idx"] = shared["idx"].at[s].set(single["idx"])
+        toks.append(tok)
+
+    got = [[] for _ in prompts]
+    tok_arr = jnp.asarray(toks, jnp.int32)
+    for _ in range(steps):
+        for s in range(len(prompts)):
+            got[s].append(int(tok_arr[s]))
+        logits, shared = model.apply(
+            variables, tok_arr[:, None], cache=shared
+        )
+        tok_arr = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    for s, p in enumerate(prompts):
+        tok, single = _prefill_single(model, variables, p)
+        want = _decode_single(model, variables, single, tok, steps)
+        assert got[s] == want, f"slot {s} diverged (prompt {p})"
+
+
+def test_per_slot_rows_are_independent():
+    """Retiring a slot (its cache becoming garbage) must not change the
+    tokens of the remaining rows — the join/leave invariant."""
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+    )
+    tok, single = _prefill_single(model, variables, [5, 3, 9])
+
+    shared = init_cache(cfg, 2, MAX_LEN, per_slot=True)
+    shared["k"] = shared["k"].at[:, 0].set(single["k"][:, 0])
+    shared["v"] = shared["v"].at[:, 0].set(single["v"][:, 0])
+    shared["idx"] = shared["idx"].at[0].set(single["idx"])
+    # slot 1: garbage (random K/V at a different depth), as after a retire
+    key = jax.random.PRNGKey(2)
+    shared["k"] = shared["k"].at[:, 1].set(
+        jax.random.normal(key, shared["k"].shape[0:1] + shared["k"].shape[2:],
+                          shared["k"].dtype)
+    )
+    shared["idx"] = shared["idx"].at[1].set(17)
+
+    toks = []
+    tok_arr = jnp.asarray([tok, 0], jnp.int32)
+    for _ in range(4):
+        toks.append(int(tok_arr[0]))
+        logits, shared = model.apply(variables, tok_arr[:, None],
+                                     cache=shared)
+        tok_arr = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    tok, single = _prefill_single(model, variables, [5, 3, 9])
+    assert toks == _decode_single(model, variables, single, tok, 4)
+
+
+def test_per_slot_rejects_multi_token_step():
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(3), jnp.zeros((1, 8), jnp.int32)
+    )
+    cache = init_cache(cfg, 2, MAX_LEN, per_slot=True)
+    with pytest.raises(ValueError, match="single-token"):
+        model.apply(variables, jnp.zeros((2, 3), jnp.int32), cache=cache)
+
+
+def test_per_slot_overflowed_slot_drops_write():
+    """An idle slot whose idx sits past the buffer matches no column: the
+    write is dropped (no clamp-corruption of column T-1) and live rows are
+    untouched."""
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(4), jnp.zeros((1, 8), jnp.int32)
+    )
+    cache = init_cache(cfg, 2, MAX_LEN, per_slot=True)
+    cache["idx"] = jnp.asarray([0, MAX_LEN + 3], jnp.int32)
+    before_last_col = np.asarray(cache["k"][:, 1, -1])
+    _, cache = model.apply(variables, jnp.ones((2, 1), jnp.int32),
+                           cache=cache)
+    np.testing.assert_array_equal(
+        np.asarray(cache["k"][:, 1, -1]), before_last_col
+    )
+    assert int(cache["idx"][1]) == MAX_LEN + 4

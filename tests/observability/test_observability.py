@@ -7,12 +7,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import pytest
+
 from sparkdl_tpu.observability import (
     StepMeter,
     aggregate_across_hosts,
     check_health,
     compiled_flops,
     device_peak_flops,
+    percentile,
     trace,
 )
 
@@ -62,6 +65,44 @@ class TestStepMeter:
     def test_summary_handles_empty(self):
         s = StepMeter(n_chips=1).summary()
         assert s["steps"] == 0 and s["mfu"] is None
+
+    def test_step_time_percentiles(self):
+        meter = StepMeter(n_chips=1, warmup_steps=0, window=200)
+        for t in range(1, 101):  # 0.01 .. 1.00 s
+            meter.record(t / 100.0, examples=1)
+        pcts = meter.step_time_percentiles()
+        assert set(pcts) == {"p50", "p95", "p99"}
+        assert math.isclose(pcts["p50"], 0.505)  # interpolated median
+        assert math.isclose(pcts["p95"], 0.9505)
+        assert math.isclose(pcts["p99"], 0.9901)
+        assert math.isclose(meter.step_time_percentile(0), 0.01)
+        assert math.isclose(meter.step_time_percentile(100), 1.0)
+
+    def test_percentiles_empty_and_single(self):
+        assert StepMeter(n_chips=1).step_time_percentile(95) is None
+        meter = StepMeter(n_chips=1, warmup_steps=0)
+        meter.record(0.25, examples=1)
+        assert meter.step_time_percentiles() == {
+            "p50": 0.25, "p95": 0.25, "p99": 0.25,
+        }
+
+
+class TestPercentile:
+    def test_matches_numpy_linear_interpolation(self):
+        rng = np.random.default_rng(7)
+        vals = rng.standard_normal(37).tolist()
+        for p in (0, 10, 50, 90, 95, 99, 100):
+            assert math.isclose(
+                percentile(vals, p), float(np.percentile(vals, p)),
+                rel_tol=1e-12, abs_tol=1e-12,
+            )
+
+    def test_empty_returns_none(self):
+        assert percentile([], 95) is None
+
+    def test_out_of_range_rejected(self):
+        with pytest.raises(ValueError, match="percentile"):
+            percentile([1.0], 101)
 
 
 class TestCompiledFlops:

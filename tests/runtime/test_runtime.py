@@ -82,9 +82,15 @@ class TestBuckets:
         with pytest.raises(ValueError, match="exceeds largest bucket"):
             pad_to_bucket({"x": np.ones((20, 2))}, (8, 16))
 
-    def test_empty_batch_rejected(self):
-        with pytest.raises(ValueError, match="empty batch"):
-            pad_to_bucket({"x": np.ones((0, 2))}, (8,))
+    def test_empty_batch_pads_with_zeros(self):
+        # serving flush ticks can fire with zero queued rows: no raise,
+        # zero-filled smallest bucket, unpad drops everything
+        b = pad_to_bucket({"x": np.ones((0, 2), np.float32)}, (8, 16))
+        assert b.n_valid == 0 and b.bucket == 8
+        assert b.arrays["x"].shape == (8, 2)
+        assert b.arrays["x"].dtype == np.float32
+        np.testing.assert_array_equal(b.arrays["x"], 0.0)
+        assert b.unpad(np.ones((8, 3))).shape == (0, 3)
 
 
 class TestPrefetch:
@@ -131,3 +137,40 @@ class TestPrefetch:
         # producer must have stopped early, not drained all 100 items
         time.sleep(0.3)
         assert len(produced) < 100
+
+    def _prefetch_threads(self):
+        import threading
+
+        return [t for t in threading.enumerate()
+                if t.name == "sparkdl-prefetch" and t.is_alive()]
+
+    def test_gc_of_abandoned_iterator_stops_producer(self):
+        # a cancelled serving request drops its iterator without close():
+        # GC alone must reap the producer thread (no leak)
+        import gc
+        import time
+
+        it = prefetch_to_device(
+            (np.full((2,), i, dtype=np.float32) for i in range(100)), size=2
+        )
+        next(it)
+        assert self._prefetch_threads()
+        del it
+        gc.collect()
+        deadline = time.time() + 5
+        while time.time() < deadline and self._prefetch_threads():
+            time.sleep(0.05)
+        assert not self._prefetch_threads(), "producer thread leaked"
+
+    def test_close_is_idempotent_and_ends_iteration(self):
+        it = prefetch_to_device(iter([np.ones((2,)), np.ones((2,))]), size=2)
+        next(it)
+        it.close()
+        it.close()
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_context_manager_closes(self):
+        with prefetch_to_device(iter([np.ones((2,))] * 5), size=2) as it:
+            next(it)
+        assert not self._prefetch_threads()

@@ -1,0 +1,172 @@
+"""ServingEngine / MicroBatcher: coalescing, isolation, drain, metrics.
+
+Runs on the virtual 8-device mesh, so the wrapped BatchedRunner takes its
+automatic dp-sharded path — the multi-chip serving configuration is the
+one under test by default.
+"""
+
+import threading
+import time
+from concurrent.futures import wait
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.serving import (
+    DeadlineExceededError,
+    EngineClosedError,
+    QueueFullError,
+    ServingEngine,
+)
+from sparkdl_tpu.transformers._inference import BatchedRunner
+
+
+def _runner(batch_size=16, **kw):
+    return BatchedRunner(lambda b: b["x"] * 2.0 + 1.0,
+                         batch_size=batch_size, **kw)
+
+
+def test_results_match_apply_fn_per_request():
+    with ServingEngine(_runner(), max_wait_s=0.002) as eng:
+        rows = [np.full((3,), float(i), np.float32) for i in range(20)]
+        futs = [eng.submit({"x": r}) for r in rows]
+        for r, f in zip(rows, futs):
+            np.testing.assert_allclose(
+                f.result(timeout=30), r * 2.0 + 1.0
+            )
+    snap = eng.snapshot()
+    assert snap["completed"] == 20 and snap["failed"] == 0
+    assert snap["latency_s"]["p95"] is not None
+
+
+def test_burst_coalesces_into_batches():
+    # stall the loop with a slow first request, pile up a burst behind
+    # it, and the burst must ride fewer dispatches than requests
+    with ServingEngine(_runner(batch_size=16), max_wait_s=0.05) as eng:
+        futs = [eng.submit({"x": np.ones((2,), np.float32) * i})
+                for i in range(16)]
+        wait(futs, timeout=30)
+    snap = eng.snapshot()
+    assert snap["completed"] == 16
+    assert snap["batches"] < 16, "no coalescing happened"
+    assert snap["batch_occupancy_pct"] > 100.0 / 16
+
+
+def test_data_parallel_disabled_still_serves():
+    with ServingEngine(_runner(data_parallel=False)) as eng:
+        f = eng.submit({"x": np.arange(4, dtype=np.float32)})
+        np.testing.assert_allclose(
+            f.result(timeout=30),
+            np.arange(4, dtype=np.float32) * 2.0 + 1.0,
+        )
+
+
+def test_bad_request_degrades_to_its_own_error():
+    def extract(payload):
+        x = np.asarray(payload["x"], np.float32)
+        if x.shape != (2,):
+            raise ValueError(f"bad row shape {x.shape}")
+        return {"x": x}
+
+    with ServingEngine(_runner(), extract=extract) as eng:
+        good = [eng.submit({"x": np.ones((2,), np.float32) * i})
+                for i in range(4)]
+        bad = eng.submit({"x": np.ones((5,), np.float32)})
+        for i, f in enumerate(good):
+            np.testing.assert_allclose(
+                f.result(timeout=30), np.ones((2,)) * i * 2.0 + 1.0
+            )
+        with pytest.raises(ValueError, match="bad row shape"):
+            bad.result(timeout=30)
+    snap = eng.snapshot()
+    assert snap["completed"] == 4 and snap["failed"] == 1
+
+
+def test_backpressure_reject_surfaces_to_submitter():
+    # tiny queue + a batcher stalled behind a slow request
+    ev = threading.Event()
+
+    def slow_extract(payload):
+        ev.wait(5.0)
+        return {"x": np.asarray(payload["x"], np.float32)}
+
+    eng = ServingEngine(_runner(batch_size=4), max_queue_depth=2,
+                        max_wait_s=0.001, extract=slow_extract)
+    try:
+        futs = [eng.submit({"x": np.ones((2,), np.float32)})]
+        deadline = time.time() + 5
+        while eng.queue.depth > 0 and time.time() < deadline:
+            time.sleep(0.005)  # wait for the blocker to be taken
+        assert eng.queue.depth == 0, "batcher never picked up the blocker"
+        futs += [eng.submit({"x": np.ones((2,), np.float32)})
+                 for _ in range(2)]  # fills the depth-2 queue
+        with pytest.raises(QueueFullError):
+            eng.submit({"x": np.ones((2,), np.float32)})
+        assert eng.snapshot()["rejected"] == 1
+    finally:
+        ev.set()
+        eng.close()
+    wait(futs, timeout=30)
+
+
+def test_deadline_expiry_mid_queue():
+    ev = threading.Event()
+
+    def slow_extract(payload):
+        ev.wait(5.0)
+        return {"x": np.asarray(payload["x"], np.float32)}
+
+    eng = ServingEngine(_runner(batch_size=1), max_wait_s=0.001,
+                        extract=slow_extract)
+    try:
+        blocker = eng.submit({"x": np.zeros((2,), np.float32)})
+        doomed = eng.submit({"x": np.zeros((2,), np.float32)},
+                            timeout_s=0.02)
+        time.sleep(0.1)
+    finally:
+        ev.set()
+        eng.close()
+    assert blocker.result(timeout=30) is not None
+    with pytest.raises(DeadlineExceededError):
+        doomed.result(timeout=30)
+
+
+def test_graceful_drain_serves_everything_admitted():
+    eng = ServingEngine(_runner(), max_wait_s=0.01)
+    futs = [eng.submit({"x": np.full((2,), float(i), np.float32)})
+            for i in range(12)]
+    eng.close(drain=True)
+    for i, f in enumerate(futs):
+        np.testing.assert_allclose(
+            f.result(timeout=0), np.full((2,), float(i)) * 2.0 + 1.0
+        )
+    with pytest.raises(EngineClosedError):
+        eng.submit({"x": np.zeros((2,), np.float32)})
+
+
+def test_non_graceful_close_fails_queued():
+    ev = threading.Event()
+
+    def slow_extract(payload):
+        ev.wait(5.0)
+        return {"x": np.asarray(payload["x"], np.float32)}
+
+    eng = ServingEngine(_runner(batch_size=1), max_wait_s=0.001,
+                        extract=slow_extract)
+    eng.submit({"x": np.zeros((2,), np.float32)})
+    queued = eng.submit({"x": np.zeros((2,), np.float32)})
+    time.sleep(0.05)
+    ev.set()
+    eng.close(drain=False)
+    with pytest.raises(EngineClosedError):
+        queued.result(timeout=30)
+
+
+def test_tuple_output_apply_fn():
+    runner = BatchedRunner(lambda b: (b["x"] * 2.0, b["x"].sum(axis=-1)),
+                           batch_size=8)
+    with ServingEngine(runner) as eng:
+        f = eng.submit({"x": np.ones((3,), np.float32)})
+        doubled, summed = f.result(timeout=30)
+        np.testing.assert_allclose(doubled, np.full((3,), 2.0))
+        np.testing.assert_allclose(summed, 3.0)

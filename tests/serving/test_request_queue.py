@@ -1,0 +1,124 @@
+"""RequestQueue: admission control, deadlines, backpressure, close."""
+
+import threading
+import time
+
+import pytest
+
+from sparkdl_tpu.serving import (
+    DeadlineExceededError,
+    EngineClosedError,
+    QueueFullError,
+    RequestQueue,
+)
+
+
+def test_fifo_order_and_payloads():
+    q = RequestQueue(max_depth=8)
+    futs = [q.submit(i) for i in range(5)]
+    reqs = q.take(10, max_wait_s=0.0)
+    assert [r.payload for r in reqs] == [0, 1, 2, 3, 4]
+    assert q.depth == 0
+    for r, f in zip(reqs, futs):
+        assert r.future is f
+
+
+def test_take_respects_max_n():
+    q = RequestQueue(max_depth=8)
+    for i in range(5):
+        q.submit(i)
+    assert [r.payload for r in q.take(3, 0.0)] == [0, 1, 2]
+    assert q.depth == 2
+
+
+def test_backpressure_rejects_past_capacity():
+    q = RequestQueue(max_depth=3)
+    for i in range(3):
+        q.submit(i)
+    with pytest.raises(QueueFullError, match="max depth 3"):
+        q.submit(99)
+    assert q.rejected == 1
+    assert q.submitted == 3
+    # draining reopens admission
+    q.take(3, 0.0)
+    q.submit(100)
+
+
+def test_full_queue_of_expired_requests_admits_live_traffic():
+    q = RequestQueue(max_depth=2)
+    dead = [q.submit(i, timeout_s=0.01) for i in range(2)]
+    time.sleep(0.05)
+    fut = q.submit("live")  # sweep evicts the corpses instead of rejecting
+    assert q.expired == 2
+    for f in dead:
+        with pytest.raises(DeadlineExceededError):
+            f.result(timeout=0)
+    assert [r.payload for r in q.take(5, 0.0)] == ["live"]
+    assert not fut.done()
+
+
+def test_deadline_expiry_mid_queue():
+    q = RequestQueue(max_depth=8)
+    f_dead = q.submit("dead", timeout_s=0.01)
+    f_live = q.submit("live")
+    time.sleep(0.05)
+    reqs = q.take(5, 0.0)
+    assert [r.payload for r in reqs] == ["live"]
+    with pytest.raises(DeadlineExceededError, match="deadline exceeded"):
+        f_dead.result(timeout=0)
+    assert not f_live.done()
+    assert q.expired == 1
+
+
+def test_cancelled_future_is_skipped():
+    q = RequestQueue(max_depth=8)
+    f = q.submit("a")
+    q.submit("b")
+    assert f.cancel()
+    assert [r.payload for r in q.take(5, 0.0)] == ["b"]
+
+
+def test_take_blocks_until_submit():
+    q = RequestQueue(max_depth=8)
+    got = []
+
+    def consumer():
+        got.extend(q.take(1, max_wait_s=2.0))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    q.submit("x")
+    t.join(timeout=2)
+    assert [r.payload for r in got] == ["x"]
+
+
+def test_take_times_out_empty():
+    q = RequestQueue(max_depth=8)
+    t0 = time.monotonic()
+    assert q.take(4, max_wait_s=0.05) == []
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_close_stops_admission_keeps_queued_takeable():
+    q = RequestQueue(max_depth=8)
+    q.submit("queued")
+    q.close()
+    with pytest.raises(EngineClosedError):
+        q.submit("late")
+    assert [r.payload for r in q.take(5, 0.0)] == ["queued"]
+
+
+def test_fail_pending():
+    q = RequestQueue(max_depth=8)
+    futs = [q.submit(i) for i in range(3)]
+    q.close()
+    assert q.fail_pending() == 3
+    for f in futs:
+        with pytest.raises(EngineClosedError):
+            f.result(timeout=0)
+
+
+def test_bad_depth_rejected():
+    with pytest.raises(ValueError, match="max_depth"):
+        RequestQueue(max_depth=0)

@@ -16,6 +16,11 @@ EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
 def _run(script: str, *args: str) -> str:
     env = dict(os.environ)
     env.setdefault("KERAS_BACKEND", "jax")
+    # examples import sparkdl_tpu from the repo root whether or not the
+    # package is pip-installed (python puts the SCRIPT dir on sys.path,
+    # not the cwd)
+    root = os.path.abspath(os.path.join(EXAMPLES, ".."))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
     out = subprocess.run(
         [sys.executable, os.path.join(EXAMPLES, script), *args],
         capture_output=True, text=True, timeout=600, env=env,
@@ -57,6 +62,12 @@ def test_distributed_resnet_training():
 def test_bert_finetune_hpo():
     out = _run("bert_finetune_hpo.py", "--evals", "2", "--epochs", "1")
     assert "best params" in out
+
+
+@pytest.mark.slow
+def test_online_serving_gpt():
+    out = _run("online_serving_gpt.py", "--requests", "6")
+    assert "continuous == unbatched: True" in out
 
 
 @pytest.mark.slow
