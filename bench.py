@@ -127,6 +127,16 @@ def main() -> None:
 
     images_per_sec = scan_k * batch * steps / dt
     target = 10_000.0
+    # The hot loop stays uninstrumented (device-resident, no framework
+    # staging on purpose); record the aggregate AFTER timing so the
+    # artifact still carries the spine's view of the run.
+    from sparkdl_tpu.observability import registry
+    from sparkdl_tpu.observability.tracing import observe_stage
+
+    registry().counter(
+        "sparkdl_bench_images_total", "images processed by bench.py"
+    ).inc(scan_k * batch * steps)
+    observe_stage("bench.featurize_step", dt / steps)
     # dp>1 reports AGGREGATE throughput; vs_baseline stays per-chip so the
     # number remains comparable to the single-chip target.
     print(
@@ -140,6 +150,7 @@ def main() -> None:
                 "value": round(images_per_sec, 1),
                 "unit": "images/sec" + ("/chip" if dp == 1 else ""),
                 "vs_baseline": round(images_per_sec / dp / target, 4),
+                "observability": registry().snapshot(),
             }
         )
     )

@@ -123,6 +123,11 @@ def main() -> None:
 
     tput_b1 = n_b1 / dur_b1
     tput_mb = n_mb / dur_mb
+    # Stage-level attribution rides the artifact (ISSUE 2): the registry
+    # snapshot covers BOTH engines' queue/latency/occupancy series, so the
+    # BENCH_*.json trajectory can tell queueing from compute regressions.
+    from sparkdl_tpu.observability import registry
+
     print(json.dumps({
         "metric": (
             f"online serving req/s, micro-batch<= {max_batch} vs batch-of-1 "
@@ -133,6 +138,7 @@ def main() -> None:
         "value": round(tput_mb, 1),
         "unit": "req/s",
         "vs_baseline": round(tput_mb / tput_b1, 4),
+        "observability": registry().snapshot(),
     }))
 
 

@@ -25,6 +25,8 @@ import json, sys
 line = sys.stdin.readline()
 rec = json.loads(line)
 assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
+# ISSUE 2: every bench artifact carries the metrics-registry snapshot
+assert "sparkdl_bench_images_total" in rec["observability"], rec.keys()
 print("bench.py contract OK")
 '
 # Local multi-chip DP hook: same contract, batch sharded over 8 fake chips.
@@ -43,7 +45,31 @@ import json, sys
 rec = json.loads(sys.stdin.readline())
 assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
 assert "micro-batch" in rec["metric"], rec
-print("bench_serving contract OK")
+# the serving spine must attribute the run: admission, latency, occupancy
+obs = rec["observability"]
+for key in ("sparkdl_queue_submitted_total", "sparkdl_serving_requests_total",
+            "sparkdl_serving_latency_seconds",
+            "sparkdl_serving_batch_occupancy_pct"):
+    assert key in obs, (key, sorted(obs))
+print("bench_serving contract OK (snapshot embedded)")
+'
+
+# Metrics-endpoint smoke (ISSUE 2): start the exporter the way production
+# does (SPARKDL_TPU_METRICS_PORT -> maybe_start_metrics_server), scrape
+# once, assert well-formed Prometheus exposition text.
+JAX_PLATFORMS=cpu SPARKDL_TPU_METRICS_PORT=0 python -c '
+import urllib.request
+from sparkdl_tpu.observability import maybe_start_metrics_server, registry
+registry().counter("sparkdl_smoke_total", "endpoint smoke").inc(3)
+srv = maybe_start_metrics_server()
+assert srv is not None, "SPARKDL_TPU_METRICS_PORT=0 must start the server"
+assert maybe_start_metrics_server() is srv, "must be idempotent"
+body = urllib.request.urlopen(
+    f"http://127.0.0.1:{srv.port}/metrics", timeout=5).read().decode()
+assert "# TYPE sparkdl_smoke_total counter" in body, body
+assert "sparkdl_smoke_total 3" in body, body
+srv.close()
+print("metrics endpoint smoke OK")
 '
 # Secondary benches keep the same one-JSON-line contract (values are
 # CPU-smoke only; the real numbers come from the chip — PERF.md).

@@ -18,9 +18,26 @@ conventions on top:
 from __future__ import annotations
 
 import os
+import time
 from typing import Any
 
 import jax
+
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.observability.tracing import record_span, span
+
+_M_SAVES = registry().counter(
+    "sparkdl_checkpoint_saves_total", "checkpoint saves queued")
+_M_RESTORES = registry().counter(
+    "sparkdl_checkpoint_restores_total", "checkpoint restores")
+_M_SAVE_TIME = registry().histogram(
+    "sparkdl_checkpoint_save_seconds",
+    "synchronous (host-snapshot) part of an async save")
+_M_RESTORE_TIME = registry().histogram(
+    "sparkdl_checkpoint_restore_seconds", "restore wall time")
+_M_WAIT_TIME = registry().histogram(
+    "sparkdl_checkpoint_wait_seconds",
+    "time blocked draining queued async saves")
 
 
 def _abstract_like(tree: Any):
@@ -65,13 +82,26 @@ class CheckpointManager:
         Returns False when the manager's save_interval policy skipped it
         (``force=True`` bypasses the policy — used for the final step).
         """
-        return self._mgr.save(
+        # span + metrics only for saves that actually happen: the interval
+        # policy skips most calls, and ~0s skip spans would pollute the
+        # checkpoint.save stage percentiles (monotonic clock: record_span
+        # and Request timestamps share time.monotonic)
+        t0 = time.monotonic()
+        saved = self._mgr.save(
             int(step), args=self._ocp.args.StandardSave(state), force=force
         )
+        if saved:
+            _M_SAVES.inc()
+            _M_SAVE_TIME.observe(time.monotonic() - t0)
+            record_span("checkpoint.save", t0, time.monotonic(),
+                        step=int(step))
+        return saved
 
     def wait(self) -> None:
         """Block until every queued async save has landed on disk."""
+        t0 = time.perf_counter()
         self._mgr.wait_until_finished()
+        _M_WAIT_TIME.observe(time.perf_counter() - t0)
 
     # -- restore -------------------------------------------------------------
     def latest_step(self) -> int | None:
@@ -93,10 +123,15 @@ class CheckpointManager:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}"
             )
-        return self._mgr.restore(
-            int(step),
-            args=self._ocp.args.StandardRestore(_abstract_like(template)),
-        )
+        t0 = time.perf_counter()
+        with span("checkpoint.restore", step=int(step)):
+            out = self._mgr.restore(
+                int(step),
+                args=self._ocp.args.StandardRestore(_abstract_like(template)),
+            )
+        _M_RESTORES.inc()
+        _M_RESTORE_TIME.observe(time.perf_counter() - t0)
+        return out
 
     def close(self) -> None:
         self._mgr.wait_until_finished()

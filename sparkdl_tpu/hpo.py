@@ -292,6 +292,17 @@ def fmin(
             and trial_runner == "threads"
             and parallelism == 1
         )
+        if _hyperopt is not None and not use_hyperopt:
+            # the silent TPE -> seeded-random downgrade cost callers search
+            # quality with no signal (ADVICE r5) — say which knob flipped
+            # the gate and how to force TPE back on
+            logger.warning(
+                "hyperopt is installed but the distributed-intent gate "
+                "(parallelism=%d, trial_runner=%r) selected seeded random "
+                "search over TPE; pass use_hyperopt=True to force the "
+                "serial TPE engine instead",
+                parallelism, trial_runner,
+            )
     if use_hyperopt:
         if _hyperopt is None:
             raise RuntimeError("hyperopt requested but not installed")

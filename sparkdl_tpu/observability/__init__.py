@@ -1,9 +1,24 @@
-"""Metrics, profiling and health — the observability the reference lacks.
+"""Metrics, tracing, profiling and health — the observability the
+reference lacks.
 
 The reference's story is "Spark executor logs + whatever TF timeline offers"
 (SURVEY.md §5 "Tracing / profiling": absent as a subsystem; "Metrics": thin
-stdout piping). The TPU build makes this first-class:
+stdout piping). The TPU build makes this first-class, around one spine:
 
+* :mod:`sparkdl_tpu.observability.registry` — the process-wide
+  :func:`registry` of counters / gauges / bucketed histograms every layer
+  (serving, prefetch, batching, training, checkpointing) reports into;
+  ``registry().snapshot()`` is the one-call JSON view, and
+  :func:`snapshot_across_hosts` rolls it up over a multi-host job;
+* :mod:`sparkdl_tpu.observability.exporters` — Prometheus ``/metrics``
+  endpoint (opt-in via ``SPARKDL_TPU_METRICS_PORT``) and a periodic
+  logline emitter;
+* :mod:`sparkdl_tpu.observability.tracing` — ``span("decode", ...)``
+  request/step tracing with contextvar propagation and Chrome
+  ``trace_event`` JSON export (Perfetto-loadable, next to
+  ``jax.profiler`` captures); span wall times feed the
+  ``sparkdl_stage_seconds`` histogram so per-stage p50/p95/p99 ride the
+  same registry;
 * :mod:`sparkdl_tpu.observability.metrics` — step-time / examples-per-sec
   per chip / MFU / infeed-starvation meters, with compiled-FLOPs lookup from
   XLA cost analysis;
@@ -15,6 +30,11 @@ stdout piping). The TPU build makes this first-class:
   detection": TPU slice health check before initialize).
 """
 
+from sparkdl_tpu.observability.exporters import (
+    MetricsServer,
+    PeriodicLogEmitter,
+    maybe_start_metrics_server,
+)
 from sparkdl_tpu.observability.health import HealthReport, check_health
 from sparkdl_tpu.observability.metrics import (
     StepMeter,
@@ -24,15 +44,44 @@ from sparkdl_tpu.observability.metrics import (
     percentile,
 )
 from sparkdl_tpu.observability.profiling import start_trace_server, trace
+from sparkdl_tpu.observability.registry import (
+    MetricsRegistry,
+    registry,
+    snapshot_across_hosts,
+)
+from sparkdl_tpu.observability.tracing import (
+    attach,
+    current_context,
+    disable_tracing,
+    enable_tracing,
+    export_chrome_trace,
+    record_span,
+    span,
+    tracing_enabled,
+)
 
 __all__ = [
     "HealthReport",
+    "MetricsRegistry",
+    "MetricsServer",
+    "PeriodicLogEmitter",
     "StepMeter",
     "aggregate_across_hosts",
+    "attach",
     "check_health",
     "compiled_flops",
+    "current_context",
     "device_peak_flops",
+    "disable_tracing",
+    "enable_tracing",
+    "export_chrome_trace",
+    "maybe_start_metrics_server",
     "percentile",
+    "record_span",
+    "registry",
+    "snapshot_across_hosts",
+    "span",
     "start_trace_server",
     "trace",
+    "tracing_enabled",
 ]

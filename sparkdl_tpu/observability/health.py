@@ -145,6 +145,14 @@ def preflight(*, skip: bool = False, profiler_port: int | None = None,
         from sparkdl_tpu.observability.profiling import start_trace_server
 
         start_trace_server(int(profiler_port) + rank)
+    # Opt-in /metrics endpoint (SPARKDL_TPU_METRICS_PORT in THIS rank's
+    # env): one line to make every worker scrape-able. Per-rank port
+    # offset so co-hosted ranks each get an endpoint (the profiler_port
+    # convention above). Idempotent, never raises — observability must
+    # not fail the job it observes.
+    from sparkdl_tpu.observability.exporters import maybe_start_metrics_server
+
+    maybe_start_metrics_server(port_offset=rank)
     return report
 
 

@@ -1,0 +1,277 @@
+"""Span-based request/step tracing with Chrome ``trace_event`` export.
+
+The timeline view the TensorFlow system paper models (PAPERS.md): every
+stage of the online path — queue wait, micro-batch assembly, device step —
+and the batch path — ingest, prefetch, run_batch — is a ``span`` whose
+wall time lands both in a Chrome/Perfetto-loadable JSON trace (open it in
+ui.perfetto.dev next to a ``jax.profiler`` capture) and in the
+``sparkdl_stage_seconds`` histogram of the metrics registry, so per-stage
+p50/p95/p99 come for free wherever tracing is on.
+
+Disabled by default: ``span()`` then returns a shared no-op context
+manager (< 1µs per use — guarded by a test) so the serving hot loop pays
+nothing. Enable with ``SPARKDL_TPU_TRACE=1`` in the environment or
+:func:`enable_tracing` in code.
+
+Cross-thread propagation: parentage rides a :mod:`contextvars` var inside
+a thread; across threads (a submitting caller → the MicroBatcher worker)
+the producer captures :func:`current_context` and the consumer re-roots
+with :func:`attach` — the pattern ``serving/queue.py`` uses so a request's
+queue-wait and device-step spans hang off the submitter's trace.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any
+
+__all__ = [
+    "SpanContext",
+    "attach",
+    "clear_trace",
+    "current_context",
+    "disable_tracing",
+    "enable_tracing",
+    "export_chrome_trace",
+    "observe_stage",
+    "record_span",
+    "span",
+    "trace_events",
+    "tracing_enabled",
+]
+
+#: Stage-duration histogram every finished span observes into.
+STAGE_METRIC = "sparkdl_stage_seconds"
+
+_stage_family = None
+_stage_bound: "dict[str, Any]" = {}
+
+
+def observe_stage(stage: str, seconds: float) -> None:
+    """Record a stage duration in the ``sparkdl_stage_seconds`` histogram.
+
+    The single owner of that metric's schema: every finished span feeds
+    through here, and instrumentation that times a stage without a span
+    (bench loops) calls it directly. Bound handles are cached per stage so
+    the hot path pays one dict hit + a float add."""
+    global _stage_family
+    bound = _stage_bound.get(stage)
+    if bound is None:
+        if _stage_family is None:
+            from sparkdl_tpu.observability.registry import registry
+
+            _stage_family = registry().histogram(
+                STAGE_METRIC, "per-stage span wall time", labels=("stage",)
+            )
+        # benign race: .labels() caches under the family lock, so two
+        # threads resolving the same stage get the same bound object
+        bound = _stage_bound[stage] = _stage_family.labels(stage=stage)
+    bound.observe(seconds)
+
+_enabled: bool = os.environ.get("SPARKDL_TPU_TRACE", "") not in ("", "0")
+_ids = itertools.count(1)
+_ids_lock = threading.Lock()
+#: bounded ring of finished-span events (dicts in trace_event shape)
+_events: "collections.deque[dict]" = collections.deque(maxlen=100_000)
+#: seconds origin for trace timestamps; one epoch per process so spans
+#: from every thread land on a common clock
+_EPOCH = time.monotonic()
+
+_now = time.monotonic
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """Identity of a live or finished span, safe to ship across threads."""
+
+    trace_id: int
+    span_id: int
+
+
+_current: "contextvars.ContextVar[SpanContext | None]" = \
+    contextvars.ContextVar("sparkdl_tpu_span", default=None)
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def enable_tracing() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable_tracing() -> None:
+    global _enabled
+    _enabled = False
+
+
+def current_context() -> "SpanContext | None":
+    """The innermost active span of THIS thread (None outside any span, or
+    with tracing off). Capture at a boundary, re-attach with :func:`attach`."""
+    if not _enabled:
+        return None
+    return _current.get()
+
+
+def _next_id() -> int:
+    with _ids_lock:
+        return next(_ids)
+
+
+class _Attach:
+    __slots__ = ("_ctx", "_token")
+
+    def __init__(self, ctx: "SpanContext | None"):
+        self._ctx = ctx
+        self._token = None
+
+    def __enter__(self):
+        self._token = _current.set(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _current.reset(self._token)
+        return False
+
+
+def attach(ctx: "SpanContext | None") -> _Attach:
+    """Context manager making ``ctx`` the ambient parent in this thread —
+    the receiving half of cross-thread propagation."""
+    return _Attach(ctx)
+
+
+class _NoopSpan:
+    """Shared do-nothing span (tracing disabled fast path)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    #: parity with _Span so instrumentation never branches on the type
+    context: "SpanContext | None" = None
+
+    def set_attr(self, **attrs: Any) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "context", "_parent", "_token", "_start")
+
+    def __init__(self, name: str, parent: "SpanContext | None",
+                 attrs: "dict[str, Any]"):
+        self.name = name
+        self.attrs = attrs
+        self._parent = parent
+        trace_id = parent.trace_id if parent is not None else _next_id()
+        self.context = SpanContext(trace_id, _next_id())
+
+    def set_attr(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "_Span":
+        self._token = _current.set(self.context)
+        self._start = _now()
+        return self
+
+    def __exit__(self, exc_type, *exc) -> bool:
+        end = _now()
+        _current.reset(self._token)
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        _finish(self.name, self._start, end, self.context,
+                self._parent, self.attrs)
+        return False
+
+
+def span(name: str, parent: "SpanContext | None" = None,
+         **attrs: Any):
+    """Open a span: ``with span("serving.device_step", rows=n): ...``.
+
+    Parent defaults to the thread's ambient span (contextvar); pass
+    ``parent=`` to re-root explicitly (e.g. a request's captured submit
+    context). With tracing disabled this returns a shared no-op and costs
+    well under a microsecond.
+    """
+    if not _enabled:
+        return _NOOP
+    if parent is None:
+        parent = _current.get()
+    return _Span(name, parent, attrs)
+
+
+def record_span(name: str, start_s: float, end_s: float,
+                parent: "SpanContext | None" = None,
+                **attrs: Any) -> "SpanContext | None":
+    """Record an already-elapsed interval as a finished span.
+
+    For stages whose start predates the instrumentation point — queue
+    wait is measured at ``take()`` from the request's enqueue stamp.
+    ``start_s``/``end_s`` are ``time.monotonic()`` seconds (the clock
+    :class:`Request` stamps with). No-op with tracing disabled.
+    """
+    if not _enabled:
+        return None
+    trace_id = parent.trace_id if parent is not None else _next_id()
+    ctx = SpanContext(trace_id, _next_id())
+    _finish(name, start_s, end_s, ctx, parent, attrs)
+    return ctx
+
+
+def _finish(name: str, start_s: float, end_s: float, ctx: SpanContext,
+            parent: "SpanContext | None", attrs: "dict[str, Any]") -> None:
+    dur = max(end_s - start_s, 0.0)
+    args = {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
+    if parent is not None:
+        args["parent_id"] = parent.span_id
+    for k, v in attrs.items():
+        args[k] = v if isinstance(v, (int, float, bool, str)) else repr(v)
+    _events.append({
+        "name": name,
+        "ph": "X",
+        "ts": (start_s - _EPOCH) * 1e6,
+        "dur": dur * 1e6,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() & 0x7FFFFFFF,
+        "args": args,
+    })
+    observe_stage(name, dur)
+
+
+def trace_events() -> "list[dict]":
+    """The finished-span ring as plain dicts (test/inspection hook)."""
+    return list(_events)
+
+
+def clear_trace() -> None:
+    _events.clear()
+
+
+def export_chrome_trace(path: "str | os.PathLike") -> int:
+    """Write the collected spans as Chrome ``trace_event`` JSON.
+
+    The file loads in ``chrome://tracing`` and https://ui.perfetto.dev —
+    same UIs that read ``jax.profiler`` captures, so serving spans and
+    XLA device traces can sit side by side. Returns the event count.
+    """
+    events = trace_events()
+    with open(path, "w") as f:
+        json.dump(
+            {"traceEvents": events, "displayTimeUnit": "ms"}, f,
+            separators=(",", ":"),
+        )
+    return len(events)

@@ -102,13 +102,47 @@ def local_pinnable_chips() -> "list[int]":
     # vfio-exposed hosts: /dev/vfio/<N> are IOMMU GROUP numbers, not
     # chip ids — TPU_VISIBLE_DEVICES wants logical chip indices, so
     # return 0..count-1 and only the numeric entries (skips the
-    # /dev/vfio/vfio control node; non-TPU vfio devices would
-    # overcount, but accel-style hosts never reach this branch)
+    # /dev/vfio/vfio control node). vfio entries alone are NOT a TPU
+    # signal — GPUs and NICs passthrough the same way — so demand a
+    # second, independent one (libtpu on the path, or a Google PCI
+    # device) before pinning; on mismatch fall back to unpinned rather
+    # than pin children to nonexistent chip indices.
     n = sum(
         1 for p in glob.glob("/dev/vfio/*")
         if re.fullmatch(r"\d+", os.path.basename(p))
     )
+    if n and not _vfio_is_tpu():
+        logger.warning(
+            "%d /dev/vfio entries but no TPU signal (no libtpu, no Google "
+            "PCI vendor id): not pinning chips — trials run unpinned", n,
+        )
+        return []
     return list(range(n))
+
+
+#: Google's PCI vendor id; TPU boards enumerate under it on vfio hosts.
+_GOOGLE_PCI_VENDOR = "0x1ae0"
+
+
+def _vfio_is_tpu() -> bool:
+    """Second TPU signal for the vfio fallback (jax-free, like the caller):
+    libtpu importable, or any PCI device with Google's vendor id."""
+    import glob
+    import importlib.util
+
+    try:
+        if importlib.util.find_spec("libtpu") is not None:
+            return True
+    except (ImportError, ValueError):
+        pass
+    for p in glob.glob("/sys/bus/pci/devices/*/vendor"):
+        try:
+            with open(p) as f:
+                if f.read().strip().lower() == _GOOGLE_PCI_VENDOR:
+                    return True
+        except OSError:
+            continue
+    return False
 
 
 class LocalProcessBackend:

@@ -31,7 +31,8 @@ class TPURunner:
     def __init__(self, np: int, driver_log_verbosity: str = "all",
                  backend=None, devices_per_process: int = 1,
                  local_platform: "str | None" = "cpu",
-                 timeout_s: float = 600.0):
+                 timeout_s: float = 600.0,
+                 metrics_summary: bool = False):
         if np == 0:
             raise ValueError("np must be a non-zero integer")
         if driver_log_verbosity not in _VERBOSITIES:
@@ -40,18 +41,30 @@ class TPURunner:
             )
         self.np = int(np)
         self.driver_log_verbosity = driver_log_verbosity
+        self.metrics_summary = metrics_summary
         self._backend = backend
         self._devices_per_process = devices_per_process
         self._local_platform = local_platform
         self._timeout_s = timeout_s
 
     def run(self, main: Callable, **kwargs: Any) -> Any:
-        """Run ``main(**kwargs)`` on all ranks; returns rank 0's result."""
+        """Run ``main(**kwargs)`` on all ranks; returns rank 0's result.
+
+        With ``metrics_summary=True`` every rank's metrics registry is
+        aggregated across hosts after its ``main`` returns (mean/min/max
+        per series via ``aggregate_across_hosts``) and rank 0 logs the
+        rollup under the ``sparkdl_tpu.metrics`` logger. The rollup is a
+        collective: if one rank's ``main`` raises, surviving ranks block
+        in it until the backend tears the job down (LocalProcessBackend
+        kills peers on first failure; a Spark barrier stage aborts), so
+        the failure still surfaces — just on the backend's timeout path.
+        """
         if not callable(main):
             raise TypeError("main must be callable")
         backend = self._backend or self._default_backend()
+        fn = _with_metrics_summary(main) if self.metrics_summary else main
         return backend.run(
-            abs(self.np), main, kwargs, verbosity=self.driver_log_verbosity
+            abs(self.np), fn, kwargs, verbosity=self.driver_log_verbosity
         )
 
     def _default_backend(self):
@@ -69,6 +82,41 @@ class TPURunner:
                 "negative np for local debug mode, or pass backend= "
                 "explicitly."
             ) from e
+
+
+def _with_metrics_summary(main: Callable) -> Callable:
+    """Wrap ``main`` so every rank joins the post-run metrics rollup.
+
+    The wrapper runs on the EXECUTOR (it rides the cloudpickled payload):
+    after the user fn returns, all ranks call
+    :func:`sparkdl_tpu.observability.snapshot_across_hosts` — a collective
+    over the flattened registry, which assumes SPMD instrumentation (every
+    rank records the same metric names, the usual case for a training
+    fn) — and rank 0 logs the mean/min/max rollup as one JSON line.
+    """
+
+    def main_with_metrics(**kwargs):
+        result = main(**kwargs)
+        import json
+        import logging
+
+        import jax
+
+        from sparkdl_tpu.observability import snapshot_across_hosts
+
+        try:
+            agg = snapshot_across_hosts()
+            if agg and jax.process_index() == 0:
+                logging.getLogger("sparkdl_tpu.metrics").info(
+                    "all-host metrics %s", json.dumps(agg, sort_keys=True)
+                )
+        except Exception:  # observability must never fail the job
+            logging.getLogger("sparkdl_tpu.metrics").warning(
+                "cross-host metrics rollup failed", exc_info=True
+            )
+        return result
+
+    return main_with_metrics
 
 
 #: Drop-in alias: reference code `HorovodRunner(np=...).run(fn)` runs as-is.

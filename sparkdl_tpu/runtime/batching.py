@@ -14,6 +14,34 @@ from typing import Iterable, Iterator, Sequence
 
 import numpy as np
 
+_METRICS = None
+
+
+def _note_batch(n_valid: int, bucket: int) -> None:
+    """Registry spine: rows vs pad rows per assembled batch, and whether
+    the batch hit its bucket exactly (pad waste is what bucket tuning
+    buys back). Lazy handles keep this module import-light."""
+    global _METRICS
+    if _METRICS is None:
+        from sparkdl_tpu.observability.registry import registry
+
+        _METRICS = (
+            registry().counter(
+                "sparkdl_batch_rows_total", "live rows through batching"),
+            registry().counter(
+                "sparkdl_batch_pad_rows_total",
+                "pad rows dispatched (wasted device work)"),
+            registry().counter(
+                "sparkdl_batch_bucket_dispatch_total",
+                "assembled batches by bucket fit", labels=("fit",)),
+        )
+    rows, pad, fit = _METRICS
+    if n_valid:
+        rows.inc(n_valid)
+    if bucket > n_valid:
+        pad.inc(bucket - n_valid)
+    fit.inc(fit="exact" if bucket == n_valid else "padded")
+
 
 def default_buckets(max_batch: int, min_bucket: int = 8) -> tuple[int, ...]:
     """Powers of two from min_bucket up to max_batch (inclusive)."""
@@ -57,12 +85,14 @@ def pad_to_bucket(arrays: dict[str, np.ndarray], buckets: Sequence[int]) -> Padd
         # pad with zeros (there is no row 0 to repeat) up to the smallest
         # bucket, n_valid=0 so unpad() drops everything.
         bucket = min(buckets)
+        _note_batch(0, bucket)
         return PaddedBatch(
             {k: np.zeros((bucket,) + a.shape[1:], a.dtype)
              for k, a in arrays.items()},
             0, bucket,
         )
     bucket = pick_bucket(n, buckets)
+    _note_batch(n, bucket)
     if bucket == n:
         return PaddedBatch(arrays, n, bucket)
     return PaddedBatch(
@@ -110,6 +140,7 @@ def _stack(rows: list[dict[str, np.ndarray]], buckets: Sequence[int]) -> PaddedB
     keys = rows[0].keys()
     n = len(rows)
     bucket = pick_bucket(n, buckets)
+    _note_batch(n, bucket)
     arrays = {k: _assemble([np.asarray(r[k]) for r in rows], bucket)
               for k in keys}
     return PaddedBatch(arrays, n, bucket)

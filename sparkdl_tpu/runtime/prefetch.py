@@ -10,14 +10,46 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterable, Iterator, TypeVar
 
 import jax
+
+from sparkdl_tpu.observability import tracing
 
 T = TypeVar("T")
 U = TypeVar("U")
 
 _SENTINEL = object()
+
+_METRICS = None
+
+
+def _metrics():
+    """Lazy registry handles (kept off the import path so the module stays
+    importable before the observability package is ready)."""
+    global _METRICS
+    if _METRICS is None:
+        from sparkdl_tpu.observability.registry import registry
+
+        _METRICS = (
+            registry().counter(
+                "sparkdl_prefetch_batches_total",
+                "batches handed from the prefetch buffer to the consumer"),
+            registry().histogram(
+                "sparkdl_prefetch_buffer_fill",
+                "buffered batches observed at each consumer take",
+                buckets=(0, 1, 2, 3, 4, 6, 8, 16, 32)),
+            registry().histogram(
+                "sparkdl_prefetch_consumer_wait_seconds",
+                "consumer time blocked waiting on the producer "
+                "(infeed starvation)"),
+            registry().counter(
+                "sparkdl_prefetch_producer_blocked_seconds_total",
+                "producer time blocked on a full buffer "
+                "(consumer is the bottleneck)"),
+        )
+    return _METRICS
 
 
 class PrefetchIterator(Iterator[U]):
@@ -53,11 +85,17 @@ class PrefetchIterator(Iterator[U]):
             # Bounded put so an abandoned consumer releases the producer
             # instead of leaking the thread and the device buffers queued
             # behind it.
+            blocked_from: "float | None" = None
             while not stop.is_set():
                 try:
                     q.put(item, timeout=0.1)
+                    if blocked_from is not None:
+                        _metrics()[3].inc(
+                            time.monotonic() - blocked_from)
                     return True
                 except queue.Full:
+                    if blocked_from is None:
+                        blocked_from = time.monotonic()
                     continue
             return False
 
@@ -83,6 +121,7 @@ class PrefetchIterator(Iterator[U]):
         # Bounded gets so a close() from another thread (request
         # cancellation) cannot strand us: once close() drains the queue
         # the sentinel may never arrive, so re-check _done each beat.
+        t0 = time.monotonic()
         while True:
             if self._done:
                 raise StopIteration
@@ -95,6 +134,14 @@ class PrefetchIterator(Iterator[U]):
                 if self._err:
                     raise self._err[0]
                 raise StopIteration
+            now = time.monotonic()
+            batches, fill, wait, _ = _metrics()
+            batches.inc()
+            # fill AFTER the take: how far ahead the producer still is —
+            # persistently 0 here == the infeed is the bottleneck
+            fill.observe(self._q.qsize())
+            wait.observe(now - t0)
+            tracing.record_span("batch.prefetch_wait", t0, now)
             return item
 
     def close(self) -> None:

@@ -32,6 +32,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from sparkdl_tpu.observability.tracing import span
 from sparkdl_tpu.serving.metrics import ServingMetrics
 from sparkdl_tpu.serving.queue import (
     DeadlineExceededError,
@@ -288,17 +289,19 @@ class ContinuousGPTEngine:
 
         gen: GenRequest = req.payload
         lp = pick_bucket(len(gen.prompt), self._len_buckets)
-        ids = np.zeros((1, lp), np.int32)
-        mask = np.zeros((1, lp), np.int32)
-        ids[0, lp - len(gen.prompt):] = gen.prompt
-        mask[0, lp - len(gen.prompt):] = 1
-        tok, row = self._prefill_fn(
-            self.variables, jnp.asarray(ids), jnp.asarray(mask)
-        )
-        self._cache = self._scatter_fn(
-            self._cache, row, jnp.asarray(slot, jnp.int32)
-        )
-        first = int(tok[0])
+        with span("serving.prefill", parent=req.trace_ctx,
+                  prompt_len=len(gen.prompt), bucket=lp, slot=slot):
+            ids = np.zeros((1, lp), np.int32)
+            mask = np.zeros((1, lp), np.int32)
+            ids[0, lp - len(gen.prompt):] = gen.prompt
+            mask[0, lp - len(gen.prompt):] = 1
+            tok, row = self._prefill_fn(
+                self.variables, jnp.asarray(ids), jnp.asarray(mask)
+            )
+            self._cache = self._scatter_fn(
+                self._cache, row, jnp.asarray(slot, jnp.int32)
+            )
+            first = int(tok[0])
         self._start[slot] = lp - len(gen.prompt)
         self._last_tok[slot] = first
         flight = _InFlight(req, [first], gen.max_new_tokens)
@@ -309,11 +312,12 @@ class ContinuousGPTEngine:
     def _decode_step(self) -> None:
         import jax.numpy as jnp
 
-        tok, self._cache = self._step_fn(
-            self.variables, self._cache,
-            jnp.asarray(self._last_tok), jnp.asarray(self._start),
-        )
-        tok = np.asarray(tok)
+        with span("serving.decode_step", slots=len(self._inflight)):
+            tok, self._cache = self._step_fn(
+                self.variables, self._cache,
+                jnp.asarray(self._last_tok), jnp.asarray(self._start),
+            )
+            tok = np.asarray(tok)
         self.metrics.record_batch(len(self._inflight), self.n_slots)
         for slot in list(self._inflight):
             flight = self._inflight[slot]

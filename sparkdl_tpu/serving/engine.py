@@ -22,6 +22,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from sparkdl_tpu.observability.exporters import maybe_start_metrics_server
 from sparkdl_tpu.serving.metrics import ServingMetrics
 from sparkdl_tpu.serving.microbatcher import MicroBatcher
 from sparkdl_tpu.serving.queue import RequestQueue
@@ -41,6 +42,9 @@ class ServingEngine:
                  max_wait_s: float = 0.005,
                  extract: Callable[[Any], dict[str, np.ndarray]] | None = None,
                  metrics: ServingMetrics | None = None):
+        # Opt-in observability endpoint (SPARKDL_TPU_METRICS_PORT):
+        # idempotent, so every engine in the process shares one server.
+        maybe_start_metrics_server()
         self.queue = RequestQueue(max_depth=max_queue_depth)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.batcher = MicroBatcher(

@@ -12,6 +12,22 @@ import threading
 from typing import Any
 
 from sparkdl_tpu.observability.metrics import StepMeter
+from sparkdl_tpu.observability.registry import PERCENT_BUCKETS, registry
+
+# The registry spine's view of every ServingMetrics instance in the
+# process (engines aggregate; per-engine detail stays on snapshot()).
+_M_REQS = registry().counter(
+    "sparkdl_serving_requests_total", "finished requests by outcome",
+    labels=("outcome",))
+_M_REQ_OK = _M_REQS.labels(outcome="completed")
+_M_REQ_FAIL = _M_REQS.labels(outcome="failed")
+_M_LATENCY = registry().histogram(
+    "sparkdl_serving_latency_seconds", "request latency, submit to result")
+_M_BATCHES = registry().counter(
+    "sparkdl_serving_batches_total", "device dispatches")
+_M_OCCUPANCY = registry().histogram(
+    "sparkdl_serving_batch_occupancy_pct",
+    "live rows per dispatch as % of capacity", buckets=PERCENT_BUCKETS)
 
 
 class ServingMetrics:
@@ -41,6 +57,8 @@ class ServingMetrics:
                 self.completed += 1
             else:
                 self.failed += 1
+        _M_LATENCY.observe(latency_s)
+        (_M_REQ_OK if ok else _M_REQ_FAIL).inc()
 
     def record_batch(self, n_valid: int, capacity: int) -> None:
         """One device dispatch: ``n_valid`` live rows of ``capacity``
@@ -51,6 +69,9 @@ class ServingMetrics:
             if capacity > 0:
                 self._occupancy.record(100.0 * n_valid / capacity,
                                        examples=n_valid)
+        _M_BATCHES.inc()
+        if capacity > 0:
+            _M_OCCUPANCY.observe(100.0 * n_valid / capacity)
 
     def latency_percentiles(self) -> dict[str, float | None]:
         with self._lock:

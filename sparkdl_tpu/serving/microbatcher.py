@@ -24,6 +24,8 @@ from typing import Any, Callable
 
 import numpy as np
 
+from sparkdl_tpu.observability import tracing
+from sparkdl_tpu.observability.tracing import span
 from sparkdl_tpu.serving.metrics import ServingMetrics
 from sparkdl_tpu.serving.queue import Request, RequestQueue
 from sparkdl_tpu.transformers._inference import BatchedRunner, try_extract
@@ -75,7 +77,7 @@ class MicroBatcher:
                 _log.warning("micro-batcher did not stop in %ss", timeout_s)
         elif drain:  # never started: drain inline so no future is stranded
             while True:
-                reqs = self.queue.take(self.runner.batch_size, 0.0)
+                reqs = self.queue.take(self.runner.chunk_size, 0.0)
                 if not reqs:
                     break
                 self._dispatch(reqs)
@@ -88,7 +90,7 @@ class MicroBatcher:
     def _loop(self) -> None:
         try:
             while not self._stop.is_set():
-                reqs = self.queue.take(self.runner.batch_size,
+                reqs = self.queue.take(self.runner.chunk_size,
                                        self.max_wait_s)
                 if not reqs:
                     if self.queue.closed and self.queue.depth == 0:
@@ -105,17 +107,28 @@ class MicroBatcher:
             raise
 
     def _dispatch(self, reqs: list[Request]) -> None:
+        # The worker thread has no ambient span; re-root on the first
+        # rider's submit-side context so batch-assembly and device-step
+        # spans land in a caller's trace (cross-thread contextvar hop).
+        batch_ctx = next(
+            (r.trace_ctx for r in reqs if r.trace_ctx is not None), None
+        )
+        with tracing.attach(batch_ctx):
+            self._dispatch_traced(reqs)
+
+    def _dispatch_traced(self, reqs: list[Request]) -> None:
         feeds: list[dict[str, np.ndarray]] = []
         live: list[Request] = []
-        for req in reqs:
-            feed, err = (try_extract(self.extract, req.payload)
-                         if self.extract is not None
-                         else (req.payload, None))
-            if err is not None:
-                self._finish(req, error=err)
-                continue
-            feeds.append(feed)
-            live.append(req)
+        with span("serving.batch_assemble", requests=len(reqs)):
+            for req in reqs:
+                feed, err = (try_extract(self.extract, req.payload)
+                             if self.extract is not None
+                             else (req.payload, None))
+                if err is not None:
+                    self._finish(req, error=err)
+                    continue
+                feeds.append(feed)
+                live.append(req)
         if not live:
             return
         try:
@@ -134,14 +147,14 @@ class MicroBatcher:
                 # each retry is a real device dispatch: count it, at its
                 # honest 1-row occupancy, so a poison-row storm shows up
                 # in the metrics instead of hiding behind them
-                self.metrics.record_batch(1, self.runner.batch_size)
+                self.metrics.record_batch(1, self.runner.chunk_size)
                 try:
                     out = self._run([feed])
                     self._finish(req, result=_row(out, 0))
                 except Exception as row_e:
                     self._finish(req, error=row_e)
             return
-        self.metrics.record_batch(len(live), self.runner.batch_size)
+        self.metrics.record_batch(len(live), self.runner.chunk_size)
         for i, req in enumerate(live):
             self._finish(req, result=_row(outs, i))
 

@@ -21,6 +21,26 @@ import time
 from concurrent.futures import Future
 from typing import Any
 
+from sparkdl_tpu.observability import tracing
+from sparkdl_tpu.observability.registry import registry
+
+# Registry mirrors of the queue's own counters (ISSUE 2: the spine sees
+# admission control without asking each engine for its snapshot). Family
+# handles are import-time singletons; registry().reset() zeroes values
+# but keeps declarations, so these never go stale.
+_M_SUBMITTED = registry().counter(
+    "sparkdl_queue_submitted_total", "requests admitted to a RequestQueue")
+_M_REJECTED = registry().counter(
+    "sparkdl_queue_rejected_total", "admission rejects (queue at max depth)")
+_M_EXPIRED = registry().counter(
+    "sparkdl_queue_expired_total", "requests whose deadline passed in queue")
+_M_CANCELLED = registry().counter(
+    "sparkdl_queue_cancelled_total", "requests cancelled by their caller")
+_M_DEPTH = registry().gauge(
+    "sparkdl_queue_depth", "currently queued requests, all queues")
+_M_WAIT = registry().histogram(
+    "sparkdl_queue_wait_seconds", "queue wait, submit to take")
+
 
 class QueueFullError(RuntimeError):
     """Admission reject: queue at max depth (backpressure — retry later)."""
@@ -37,12 +57,15 @@ class EngineClosedError(RuntimeError):
 @dataclasses.dataclass
 class Request:
     """One queued unit of work. ``deadline`` is absolute ``time.monotonic``
-    seconds (None = no deadline); ``enqueued`` stamps queue-wait metrics."""
+    seconds (None = no deadline); ``enqueued`` stamps queue-wait metrics.
+    ``trace_ctx`` carries the submitter's span context across the thread
+    boundary so queue-wait and device-step spans hang off its trace."""
 
     payload: Any
     future: Future
     deadline: float | None
     enqueued: float
+    trace_ctx: "tracing.SpanContext | None" = None
 
     def expired(self, now: float | None = None) -> bool:
         return (self.deadline is not None
@@ -75,11 +98,30 @@ class RequestQueue:
         self._dq: collections.deque[Request] = collections.deque()
         self._cv = threading.Condition()
         self._closed = False
+        #: depth last pushed to the shared gauge — the gauge carries the
+        #: SUM over all live queues, so each queue contributes deltas
+        #: rather than set() (which would clobber its neighbors). The
+        #: generation stamp detects registry().reset() wiping the gauge
+        #: under us (test isolation): the baseline restarts at 0.
+        self._reported_depth = 0
+        self._reported_gen = registry().generation
         #: monotonically increasing counters (read under no lock: ints)
         self.submitted = 0
         self.rejected = 0
         self.expired = 0
         self.cancelled = 0
+
+    def _update_depth_locked(self) -> None:
+        """Push this queue's depth change to the shared gauge as a delta
+        (called under ``self._cv``)."""
+        gen = registry().generation
+        if gen != self._reported_gen:  # reset() zeroed our contribution
+            self._reported_depth = 0
+            self._reported_gen = gen
+        depth = len(self._dq)
+        if depth != self._reported_depth:
+            _M_DEPTH.inc(depth - self._reported_depth)
+            self._reported_depth = depth
 
     @property
     def depth(self) -> int:
@@ -103,13 +145,19 @@ class RequestQueue:
                 self._sweep_expired_locked(now)
             if len(self._dq) >= self.max_depth:
                 self.rejected += 1
+                _M_REJECTED.inc()
                 raise QueueFullError(
                     f"queue at max depth {self.max_depth}; retry with "
                     "backoff or raise capacity"
                 )
             fut: Future = Future()
-            self._dq.append(Request(payload, fut, deadline, now))
+            self._dq.append(Request(
+                payload, fut, deadline, now,
+                trace_ctx=tracing.current_context(),
+            ))
             self.submitted += 1
+            _M_SUBMITTED.inc()
+            self._update_depth_locked()
             self._cv.notify()
             return fut
 
@@ -138,14 +186,25 @@ class RequestQueue:
                 req = self._dq.popleft()
                 if req.expired(now):
                     self.expired += 1
+                    _M_EXPIRED.inc()
                     req.fail_expired()
                     continue
                 # a caller that cancelled its Future no longer wants the
                 # result; set_running_or_notify_cancel is the handshake
                 if not req.future.set_running_or_notify_cancel():
                     self.cancelled += 1
+                    _M_CANCELLED.inc()
                     continue
                 out.append(req)
+            self._update_depth_locked()
+        for req in out:
+            _M_WAIT.observe(now - req.enqueued)
+            # retroactive span: the wait started at submit, long before
+            # this instrumentation point, parented on the submitter
+            tracing.record_span(
+                "serving.queue_wait", req.enqueued, now,
+                parent=req.trace_ctx,
+            )
         return out
 
     def close(self) -> None:
@@ -168,7 +227,9 @@ class RequestQueue:
                     req.future.set_exception(exc)
                 else:
                     self.cancelled += 1
+                    _M_CANCELLED.inc()
                 n += 1
+            self._update_depth_locked()
         return n
 
     def sweep_expired(self) -> None:
@@ -184,6 +245,8 @@ class RequestQueue:
         for r in self._dq:
             if r.expired(now):
                 self.expired += 1
+                _M_EXPIRED.inc()
                 r.fail_expired()
         self._dq.clear()
         self._dq.extend(live)
+        self._update_depth_locked()

@@ -20,7 +20,16 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.observability.tracing import span
 from sparkdl_tpu.runtime.mesh import data_parallel_mesh, mesh_context
+
+_M_STEPS = registry().counter(
+    "sparkdl_train_steps_total", "optimizer steps taken")
+_M_EXAMPLES = registry().counter(
+    "sparkdl_train_examples_total", "examples consumed by training")
+_M_STEP_TIME = registry().histogram(
+    "sparkdl_train_step_seconds", "train step wall time (dispatch + sync)")
 
 
 @flax.struct.dataclass
@@ -135,15 +144,20 @@ def finetune_classifier(
             for i, batch in enumerate(batches):
                 if i < resume_step:  # deterministic iterator replay on resume
                     continue
-                batch = {
-                    k: jax.device_put(jnp.asarray(v), data_sharding)
-                    for k, v in batch.items()
-                }
-                t0 = time.perf_counter()
-                state, metrics = step(state, batch)
-                metrics = {k: float(v) for k, v in metrics.items()}
-                metrics["step_time_s"] = time.perf_counter() - t0
+                n_examples = len(next(iter(batch.values())))
+                with span("train.step", step=i, examples=n_examples):
+                    batch = {
+                        k: jax.device_put(jnp.asarray(v), data_sharding)
+                        for k, v in batch.items()
+                    }
+                    t0 = time.perf_counter()
+                    state, metrics = step(state, batch)
+                    metrics = {k: float(v) for k, v in metrics.items()}
+                    metrics["step_time_s"] = time.perf_counter() - t0
                 metrics["step"] = int(state.step)
+                _M_STEPS.inc()
+                _M_EXAMPLES.inc(n_examples)
+                _M_STEP_TIME.observe(metrics["step_time_s"])
                 history.append(metrics)
                 if metrics_cb is not None:
                     metrics_cb(metrics)

@@ -16,6 +16,7 @@ from typing import Any, Callable, Iterator
 import jax
 import numpy as np
 
+from sparkdl_tpu.observability.tracing import span
 from sparkdl_tpu.runtime.batching import (
     default_buckets,
     pad_to_bucket,
@@ -68,6 +69,7 @@ class BatchedRunner:
 
     def __post_init__(self):
         self._jitted = jax.jit(self.apply_fn)
+        self._chunk = self.batch_size
         self._buckets = default_buckets(self.batch_size)
         self._sharding = None
         n_local = jax.local_device_count()
@@ -95,14 +97,29 @@ class BatchedRunner:
                 self._sharding = batch_sharding(mesh)
                 # round the chunk size DOWN to a device multiple (never
                 # above the caller's memory ask): full batches then hit
-                # their bucket exactly instead of paying pad rows forever
-                self.batch_size = max(
+                # their bucket exactly instead of paying pad rows forever.
+                # The caller-supplied batch_size field stays untouched —
+                # the rounded value is the private dispatch chunk.
+                self._chunk = max(
                     n_use, self.batch_size // n_use * n_use
                 )
+                if self._chunk != self.batch_size:
+                    logging.getLogger(__name__).debug(
+                        "batch_size %d rounded to %d-device dp chunk %d "
+                        "(configured value preserved on .batch_size)",
+                        self.batch_size, n_use, self._chunk,
+                    )
                 self._buckets = tuple(sorted({
                     -(-b // n_use) * n_use
-                    for b in default_buckets(self.batch_size)
+                    for b in default_buckets(self._chunk)
                 }))
+
+    @property
+    def chunk_size(self) -> int:
+        """Rows per device dispatch: ``batch_size`` rounded down to a
+        multiple of the dp device count (equal to ``batch_size`` on
+        single-device hosts)."""
+        return self._chunk
 
     def run(self, rows: Iterator[dict[str, np.ndarray]]) -> Iterator[np.ndarray]:
         """Yield one output per input row, in order.
@@ -110,7 +127,7 @@ class BatchedRunner:
         Single-array apply_fns yield arrays; tuple-valued apply_fns (e.g.
         multi-output ingested graphs) yield per-row tuples.
         """
-        batches = rebatch(rows, self.batch_size, self._buckets)
+        batches = rebatch(rows, self._chunk, self._buckets)
         # keep (n_valid) alongside the device computation
         metas: list[int] = []
 
@@ -120,14 +137,19 @@ class BatchedRunner:
                 yield b.arrays
 
         results = self._device_feed(host_batches())
-        for i, out in enumerate(map(self._jitted, results)):
+        for i, staged in enumerate(results):
             n = metas[i]
-            if isinstance(out, (tuple, list)):
-                arrays = [np.asarray(o) for o in out]
+            with span("batch.device_step", rows=n):
+                out = self._jitted(staged)
+                if isinstance(out, (tuple, list)):
+                    arrays: Any = [np.asarray(o) for o in out]
+                else:
+                    arrays = np.asarray(out)
+            if isinstance(arrays, list):
                 for j in range(n):
                     yield tuple(a[j] for a in arrays)
             else:
-                yield from np.asarray(out)[:n]
+                yield from arrays[:n]
 
     def _device_feed(
         self, host_batches: Iterator[dict[str, np.ndarray]]
@@ -181,10 +203,12 @@ class BatchedRunner:
         just with 0 rows.
         """
         padded = pad_to_bucket(arrays, self._buckets)
-        out = self._jitted(self._transfer(padded.arrays))
-        if isinstance(out, (tuple, list)):
-            return tuple(np.asarray(o)[: padded.n_valid] for o in out)
-        return np.asarray(out)[: padded.n_valid]
+        with span("serving.device_step", rows=padded.n_valid,
+                  bucket=padded.bucket):
+            out = self._jitted(self._transfer(padded.arrays))
+            if isinstance(out, (tuple, list)):
+                return tuple(np.asarray(o)[: padded.n_valid] for o in out)
+            return np.asarray(out)[: padded.n_valid]
 
     def _transfer(self, arrays: dict[str, np.ndarray]):
         if self._sharding is not None:

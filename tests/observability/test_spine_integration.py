@@ -1,0 +1,79 @@
+"""ISSUE 2 acceptance: ONE ``registry().snapshot()`` surfaces live metrics
+from serving, prefetch, batching, training, and checkpointing in the same
+run — no per-subsystem snapshot stitching."""
+
+import numpy as np
+
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.runtime.prefetch import prefetch_to_device
+from sparkdl_tpu.serving import ServingEngine
+from sparkdl_tpu.transformers._inference import BatchedRunner
+
+
+def test_one_snapshot_spans_all_layers(tmp_path):
+    registry().reset()
+
+    # -- serving (queue + micro-batcher + run_batch -> batching) -------------
+    runner = BatchedRunner(
+        lambda b: b["x"] + 1.0, batch_size=8, data_parallel=False
+    )
+    with ServingEngine(runner, max_wait_s=0.001) as eng:
+        futs = [eng.submit({"x": np.full((3,), float(i), np.float32)})
+                for i in range(5)]
+        for i, f in enumerate(futs):
+            np.testing.assert_array_equal(
+                f.result(timeout=30), np.full((3,), i + 1.0)
+            )
+
+    # -- prefetch (the host->device staging pipeline) ------------------------
+    rows = [np.full((2,), i, np.float32) for i in range(4)]
+    got = list(prefetch_to_device(iter(rows), size=2, transfer=lambda x: x))
+    assert len(got) == 4
+
+    # -- training + checkpointing (finetune loop with async saves) -----------
+    from sparkdl_tpu.train import finetune_classifier
+    from sparkdl_tpu.train.finetune import batches_from_arrays
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 4)).astype(np.float32)
+    labels = (x[:, 0] > 0).astype(np.int32)
+    batches = list(batches_from_arrays(
+        {"x": x, "labels": labels}, batch_size=16, epochs=2
+    ))
+    params = {"w": np.zeros((4, 2), np.float32)}
+    finetune_classifier(
+        lambda p, x: x @ p["w"], params, batches, learning_rate=0.1,
+        checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=1,
+    )
+
+    # -- the one call ---------------------------------------------------------
+    snap = registry().snapshot()
+    for layer, key in {
+        "serving": "sparkdl_serving_requests_total",
+        "serving-queue": "sparkdl_queue_submitted_total",
+        "serving-latency": "sparkdl_serving_latency_seconds",
+        "prefetch": "sparkdl_prefetch_batches_total",
+        "batching": "sparkdl_batch_rows_total",
+        "batching-buckets": "sparkdl_batch_bucket_dispatch_total",
+        "training": "sparkdl_train_steps_total",
+        "training-time": "sparkdl_train_step_seconds",
+        "checkpointing": "sparkdl_checkpoint_saves_total",
+        "checkpointing-time": "sparkdl_checkpoint_save_seconds",
+    }.items():
+        assert key in snap, f"{layer} metrics missing from the snapshot"
+
+    assert snap["sparkdl_serving_requests_total"]["values"][
+        'outcome="completed"'] == 5
+    assert snap["sparkdl_queue_submitted_total"]["values"][""] == 5
+    assert snap["sparkdl_train_steps_total"]["values"][""] == len(batches)
+    assert snap["sparkdl_checkpoint_saves_total"]["values"][""] >= 1
+    # serving dispatched 5 one-row requests into >= 1 bucketed batches:
+    # live rows and pad rows both show up in the batching spine
+    assert snap["sparkdl_batch_rows_total"]["values"][""] >= 5
+    assert "sparkdl_batch_pad_rows_total" in snap
+
+    # and the same state renders as valid exposition text for scrapers
+    text = registry().to_prometheus()
+    assert "# TYPE sparkdl_serving_requests_total counter" in text
+    assert "# TYPE sparkdl_train_step_seconds histogram" in text
+    assert "sparkdl_train_step_seconds_bucket" in text

@@ -1,0 +1,189 @@
+"""Span tracing: nesting, cross-thread propagation, export, overhead."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.observability import tracing
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.observability.tracing import (
+    attach,
+    current_context,
+    export_chrome_trace,
+    record_span,
+    span,
+    trace_events,
+)
+
+
+@pytest.fixture
+def traced():
+    """Tracing on, clean event ring; always restored to off."""
+    tracing.clear_trace()
+    tracing.enable_tracing()
+    try:
+        yield
+    finally:
+        tracing.disable_tracing()
+        tracing.clear_trace()
+
+
+def _by_name(name):
+    evs = [e for e in trace_events() if e["name"] == name]
+    assert evs, f"no span named {name!r} in {sorted({e['name'] for e in trace_events()})}"
+    return evs
+
+
+class TestSpans:
+    def test_nesting_links_parent_and_shares_trace(self, traced):
+        with span("outer") as outer:
+            with span("inner"):
+                time.sleep(0.002)
+        inner_ev = _by_name("inner")[0]
+        outer_ev = _by_name("outer")[0]
+        assert inner_ev["args"]["parent_id"] == outer_ev["args"]["span_id"]
+        assert inner_ev["args"]["trace_id"] == outer_ev["args"]["trace_id"]
+        assert "parent_id" not in outer_ev["args"]
+        # the child interval sits inside the parent's
+        assert inner_ev["ts"] >= outer_ev["ts"]
+        assert (inner_ev["ts"] + inner_ev["dur"]
+                <= outer_ev["ts"] + outer_ev["dur"] + 1)
+        assert outer.context is not None
+
+    def test_contextvar_isolated_per_thread(self, traced):
+        seen = {}
+
+        def other():
+            seen["ctx"] = current_context()
+
+        with span("parent"):
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+            assert current_context() is not None
+        assert seen["ctx"] is None  # fresh thread starts rootless
+
+    def test_attach_carries_context_across_threads(self, traced):
+        with span("submitter") as s:
+            ctx = current_context()
+
+        def worker():
+            with attach(ctx):
+                with span("worker_side"):
+                    pass
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        ev = _by_name("worker_side")[0]
+        assert ev["args"]["parent_id"] == s.context.span_id
+        assert ev["args"]["trace_id"] == s.context.trace_id
+
+    def test_record_span_retroactive(self, traced):
+        t0 = time.monotonic() - 0.05
+        ctx = record_span("queue_wait", t0, time.monotonic(),
+                          request_id="r1")
+        ev = _by_name("queue_wait")[0]
+        assert ev["dur"] == pytest.approx(0.05e6, rel=0.3)
+        assert ev["args"]["request_id"] == "r1"
+        assert ctx is not None
+
+    def test_error_annotation(self, traced):
+        with pytest.raises(RuntimeError):
+            with span("bad"):
+                raise RuntimeError("x")
+        assert _by_name("bad")[0]["args"]["error"] == "RuntimeError"
+
+    def test_spans_feed_stage_histogram(self, traced):
+        registry().reset()
+        with span("stage_a"):
+            time.sleep(0.001)
+        snap = registry().snapshot()[tracing.STAGE_METRIC]["values"]
+        assert snap['stage="stage_a"']["count"] == 1
+        assert snap['stage="stage_a"']["sum"] >= 0.001
+
+    def test_chrome_export_loads_in_perfetto_shape(self, traced, tmp_path):
+        with span("export_me", rows=4):
+            pass
+        path = tmp_path / "trace.json"
+        n = export_chrome_trace(path)
+        assert n >= 1
+        doc = json.loads(path.read_text())
+        ev = [e for e in doc["traceEvents"] if e["name"] == "export_me"][0]
+        # the trace_event contract Perfetto/chrome://tracing require
+        assert ev["ph"] == "X"
+        assert {"ts", "dur", "pid", "tid"} <= ev.keys()
+        assert ev["args"]["rows"] == 4
+
+
+class TestDisabled:
+    def test_disabled_records_nothing(self):
+        tracing.disable_tracing()
+        tracing.clear_trace()
+        with span("ghost"):
+            pass
+        assert record_span("ghost2", 0.0, 1.0) is None
+        assert current_context() is None
+        assert trace_events() == []
+
+    def test_noop_span_overhead_under_1us(self):
+        """The disabled-path guard (ISSUE 2 acceptance): serving hot
+        loops wrap every dispatch in span(), so the no-op must stay
+        effectively free. Best-of-10 short batches: the MIN is the true
+        cost, the other batches absorb scheduler noise on loaded hosts."""
+        tracing.disable_tracing()
+        n = 10_000
+        best = float("inf")
+        for _ in range(10):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                with span("off", rows=1):
+                    pass
+            best = min(best, (time.perf_counter() - t0) / n)
+        assert best < 1e-6, f"no-op span costs {best * 1e9:.0f}ns"
+
+
+class TestServingPropagation:
+    def test_request_spans_cross_microbatcher_thread(self, tmp_path):
+        """The ISSUE 2 online-path contract: a request submitted inside a
+        caller span produces queue-wait / batch-assembly / device-step
+        spans in the MicroBatcher WORKER thread, all linked to the
+        submitter's trace via the Request-carried context."""
+        from sparkdl_tpu.serving import ServingEngine
+        from sparkdl_tpu.transformers._inference import BatchedRunner
+
+        tracing.clear_trace()
+        tracing.enable_tracing()
+        try:
+            runner = BatchedRunner(
+                lambda b: b["x"] * 2.0, batch_size=8, data_parallel=False
+            )
+            with ServingEngine(runner, max_wait_s=0.001) as eng:
+                with span("client_call") as client:
+                    fut = eng.submit({"x": np.ones((3,), np.float32)})
+                    np.testing.assert_array_equal(
+                        fut.result(timeout=30), np.full((3,), 2.0)
+                    )
+            trace_id = client.context.trace_id
+            main_tid = threading.get_ident() & 0x7FFFFFFF
+            for name in ("serving.queue_wait", "serving.batch_assemble",
+                         "serving.device_step"):
+                evs = [e for e in _by_name(name)
+                       if e["args"]["trace_id"] == trace_id]
+                assert evs, f"{name} not linked to the client trace"
+            # assemble/step genuinely ran on the worker thread
+            assert _by_name("serving.batch_assemble")[0]["tid"] != main_tid
+            # and the whole request exports as a Perfetto-loadable trace
+            # (the ISSUE 2 acceptance artifact)
+            path = tmp_path / "serving_trace.json"
+            export_chrome_trace(path)
+            doc = json.loads(path.read_text())
+            names = {e["name"] for e in doc["traceEvents"]}
+            assert {"serving.queue_wait", "serving.batch_assemble",
+                    "serving.device_step"} <= names
+        finally:
+            tracing.disable_tracing()
+            tracing.clear_trace()

@@ -63,3 +63,44 @@ def test_positive_np_without_cluster():
 def test_bad_verbosity_rejected():
     with pytest.raises(ValueError):
         TPURunner(np=-1, driver_log_verbosity="loud")
+
+
+class _InlineBackend:
+    """Runs the (possibly wrapped) fn in-process — isolates the
+    metrics_summary wrapper from real process launching."""
+
+    def run(self, nprocs, fn, kwargs, verbosity="all"):
+        return fn(**kwargs)
+
+
+def test_metrics_summary_logs_cross_host_rollup(caplog):
+    """metrics_summary=True: after main returns, every rank joins the
+    aggregate_across_hosts rollup of the metrics registry and rank 0
+    logs it (single-process here: mean == min == max == local value)."""
+    import json
+    import logging
+
+    from sparkdl_tpu.observability.registry import registry
+
+    registry().reset()
+
+    def main(n):
+        registry().counter("sparkdl_rollup_probe_total").inc(n)
+        return n * 2
+
+    runner = TPURunner(np=-1, backend=_InlineBackend(),
+                       metrics_summary=True)
+    with caplog.at_level(logging.INFO, logger="sparkdl_tpu.metrics"):
+        assert runner.run(main, n=3) == 6
+    recs = [r for r in caplog.records if "all-host metrics" in r.message]
+    assert recs, caplog.records
+    agg = json.loads(recs[0].message.split("all-host metrics ", 1)[1])
+    assert agg["sparkdl_rollup_probe_total"] == {
+        "mean": 3.0, "min": 3.0, "max": 3.0,
+    }
+    # default stays off: no wrapper, no rollup logline
+    caplog.clear()
+    registry().reset()
+    with caplog.at_level(logging.INFO, logger="sparkdl_tpu.metrics"):
+        TPURunner(np=-1, backend=_InlineBackend()).run(main, n=1)
+    assert not [r for r in caplog.records if "all-host metrics" in r.message]

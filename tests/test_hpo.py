@@ -145,6 +145,69 @@ def test_local_pinnable_chips_detection(monkeypatch):
     assert backends.local_pinnable_chips() == [0, 1]
 
 
+def test_vfio_fallback_demands_second_tpu_signal(monkeypatch):
+    """/dev/vfio entries alone must not pin (GPUs/NICs passthrough the
+    same way — ADVICE r5): pinning needs libtpu or a Google PCI vendor id,
+    else the pool is unpinned rather than pointing children at
+    nonexistent chip indices."""
+    from sparkdl_tpu.runner import backends
+
+    monkeypatch.delenv("TPU_VISIBLE_DEVICES", raising=False)
+    monkeypatch.setattr(
+        "glob.glob",
+        lambda pat: (["/dev/vfio/0", "/dev/vfio/1", "/dev/vfio/vfio"]
+                     if pat == "/dev/vfio/*" else []),
+    )
+    # vfio entries + confirmed TPU signal -> logical chip indices
+    monkeypatch.setattr(backends, "_vfio_is_tpu", lambda: True)
+    assert backends.local_pinnable_chips() == [0, 1]
+    # same entries, no TPU signal -> unpinned fallback
+    monkeypatch.setattr(backends, "_vfio_is_tpu", lambda: False)
+    assert backends.local_pinnable_chips() == []
+
+
+def test_vfio_is_tpu_checks_pci_vendor(monkeypatch, tmp_path):
+    """The second signal itself: Google's PCI vendor id qualifies, other
+    vendors don't (libtpu lookup forced to miss so ONLY the PCI path is
+    under test — the dev image actually ships libtpu)."""
+    from sparkdl_tpu.runner import backends
+
+    monkeypatch.setattr("importlib.util.find_spec", lambda name: None)
+    vendor = tmp_path / "vendor"
+    vendor.write_text("0x1ae0\n")
+    monkeypatch.setattr(
+        "glob.glob",
+        lambda pat: ([str(vendor)]
+                     if pat == "/sys/bus/pci/devices/*/vendor" else []),
+    )
+    assert backends._vfio_is_tpu() is True
+    vendor.write_text("0x10de\n")
+    assert backends._vfio_is_tpu() is False
+
+
+def test_fmin_warns_when_tpe_gate_bypasses_installed_hyperopt(
+        monkeypatch, caplog):
+    """ADVICE r5: the distributed-intent gate silently downgraded TPE to
+    seeded random search; callers must hear about it and the forcing
+    knob."""
+    import logging
+
+    from sparkdl_tpu import hpo
+
+    monkeypatch.setattr(hpo, "_hyperopt", object())  # "installed"
+    space = {"x": hp.uniform("x", 0, 1)}
+    with caplog.at_level(logging.WARNING, logger="sparkdl_tpu.hpo"):
+        fmin(lambda p: p["x"], space, max_evals=2, parallelism=2, seed=0)
+    assert any("use_hyperopt=True" in r.message and "TPE" in r.message
+               for r in caplog.records), caplog.records
+    # an explicit use_hyperopt=False is a decision, not a surprise: quiet
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="sparkdl_tpu.hpo"):
+        fmin(lambda p: p["x"], space, max_evals=2, use_hyperopt=False,
+             seed=0)
+    assert not caplog.records
+
+
 class _FakeRDD:
     def __init__(self, data):
         self.data = data

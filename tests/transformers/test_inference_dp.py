@@ -56,13 +56,16 @@ def test_dp_buckets_divide_device_count():
     runner = BatchedRunner(apply_fn, batch_size=50)
     n = runner._sharding.num_devices
     # chunk size rounds DOWN to a device multiple (never above the
-    # caller's memory ask) so full batches hit their bucket exactly
-    assert runner.batch_size == 48
+    # caller's memory ask) so full batches hit their bucket exactly —
+    # while the caller-supplied batch_size field stays what was configured
+    assert runner.batch_size == 50
+    assert runner.chunk_size == 48
     assert all(b % n == 0 for b in runner._buckets)
     assert max(runner._buckets) == 48
     # tiny batch sizes shrink the mesh rather than over-padding
     small = BatchedRunner(apply_fn, batch_size=2)
     assert small._sharding.num_devices == 2
+    assert small.chunk_size == 2
     assert small._buckets == (2,)
 
 
