@@ -128,6 +128,22 @@ def main() -> None:
     # BENCH_*.json trajectory can tell queueing from compute regressions.
     from sparkdl_tpu.observability import registry
 
+    # Dispatch spine (ISSUE 3): run_batch records every serving dispatch
+    # (count + wall) into the registry; the calibrated gap then splits
+    # device-step wall into program vs dispatch overhead for the artifact.
+    from sparkdl_tpu.runtime.dispatch import (
+        calibrate_dispatch_gap,
+        dispatch_count,
+        overhead_share,
+    )
+
+    gap = calibrate_dispatch_gap()
+    n_dispatches = dispatch_count("serving")
+    snap_wall = registry().snapshot().get(
+        "sparkdl_dispatch_seconds", {}
+    ).get("values", {}).get('path="serving"', {})
+    share = overhead_share(n_dispatches, snap_wall.get("sum") or 0.0, gap)
+
     print(json.dumps({
         "metric": (
             f"online serving req/s, micro-batch<= {max_batch} vs batch-of-1 "
@@ -138,6 +154,9 @@ def main() -> None:
         "value": round(tput_mb, 1),
         "unit": "req/s",
         "vs_baseline": round(tput_mb / tput_b1, 4),
+        "dispatch_count": n_dispatches,
+        "dispatch_gap_ms": round(gap * 1e3, 4),
+        "overhead_share": round(share, 4) if share is not None else None,
         "observability": registry().snapshot(),
     }))
 

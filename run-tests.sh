@@ -27,7 +27,34 @@ rec = json.loads(line)
 assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
 # ISSUE 2: every bench artifact carries the metrics-registry snapshot
 assert "sparkdl_bench_images_total" in rec["observability"], rec.keys()
+# ISSUE 3: the artifact attributes dispatch amortization, not just img/s
+assert rec["dispatch_count"] == 2, rec
+assert 0 <= rec["overhead_share"] <= 1, rec
+assert "sparkdl_dispatches_total" in rec["observability"], rec.keys()
 print("bench.py contract OK")
+'
+# Fused-dispatch smoke (ISSUE 3): a chained BatchedRunner.run must issue
+# ~K-fold fewer device dispatches than the unchained runner on the same
+# stream, with bitwise-identical outputs.
+JAX_PLATFORMS=cpu python -c '
+import numpy as np, jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.runtime.dispatch import dispatch_count
+from sparkdl_tpu.transformers._inference import BatchedRunner
+w = jnp.asarray(np.random.default_rng(0).standard_normal((8, 8)), jnp.float32)
+rows = [{"x": np.random.default_rng(i).standard_normal(8).astype(np.float32)}
+        for i in range(32)]
+base = list(BatchedRunner(lambda b: jnp.tanh(b["x"] @ w), batch_size=4,
+                          data_parallel=False, chain_k=1).run(iter(rows)))
+d0 = dispatch_count("batch")
+assert d0 == 8, d0
+got = list(BatchedRunner(lambda b: jnp.tanh(b["x"] @ w), batch_size=4,
+                         data_parallel=False, chain_k=8).run(iter(rows)))
+d1 = dispatch_count("batch") - d0
+assert d1 == 1, d1  # 8 batches, one fused dispatch
+for g, b in zip(got, base):
+    np.testing.assert_array_equal(g, b)
+print("fused-dispatch smoke OK: 8 dispatches -> 1 at K=8, bitwise equal")
 '
 # Local multi-chip DP hook: same contract, batch sharded over 8 fake chips.
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -51,6 +78,9 @@ for key in ("sparkdl_queue_submitted_total", "sparkdl_serving_requests_total",
             "sparkdl_serving_latency_seconds",
             "sparkdl_serving_batch_occupancy_pct"):
     assert key in obs, (key, sorted(obs))
+# ISSUE 3: serving dispatches counted + overhead share attributed
+assert rec["dispatch_count"] > 0, rec
+assert "sparkdl_dispatch_seconds" in obs, sorted(obs)
 print("bench_serving contract OK (snapshot embedded)")
 '
 
@@ -85,6 +115,8 @@ done
 # The driver's EXACT call form: import the module, call dryrun_multichip(8)
 # with however many devices this host exposes (1 here — JAX_PLATFORMS=cpu
 # without a forced device count), so the self-provisioning re-exec path is
-# what gets tested, not an env-prepared shortcut.
-JAX_PLATFORMS=cpu python -c 'import __graft_entry__ as g; g.dryrun_multichip(8)'
+# what gets tested, not an env-prepared shortcut. SPARKDL_TPU_CHAIN_K=2
+# pins K=2 for every auto-mode chainer (ISSUE 3): the regimes must all
+# still pass with fused dispatch enabled wherever it auto-applies.
+JAX_PLATFORMS=cpu SPARKDL_TPU_CHAIN_K=2 python -c 'import __graft_entry__ as g; g.dryrun_multichip(8)'
 SDL_SKIP_DRYRUN=1 python __graft_entry__.py

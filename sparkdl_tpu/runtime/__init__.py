@@ -14,6 +14,13 @@ from sparkdl_tpu.runtime.batching import (
     pad_to_bucket,
     rebatch,
 )
+from sparkdl_tpu.runtime.dispatch import (
+    ChainPolicy,
+    ScanChainer,
+    calibrate_dispatch_gap,
+    chain_carry,
+    overhead_share,
+)
 from sparkdl_tpu.runtime.prefetch import (
     PrefetchIterator,
     pipelined_map,
@@ -22,15 +29,20 @@ from sparkdl_tpu.runtime.prefetch import (
 
 __all__ = [
     "AXIS_ORDER",
+    "ChainPolicy",
     "DtypePolicy",
     "FLOAT32",
     "MeshSpec",
     "PaddedBatch",
     "PrefetchIterator",
+    "ScanChainer",
     "batch_sharding",
+    "calibrate_dispatch_gap",
+    "chain_carry",
     "data_parallel_mesh",
     "default_buckets",
     "default_policy",
+    "overhead_share",
     "pad_batch_to_multiple",
     "pad_to_bucket",
     "pipelined_map",

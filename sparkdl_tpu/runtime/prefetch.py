@@ -124,10 +124,22 @@ class PrefetchIterator(Iterator[U]):
         t0 = time.monotonic()
         while True:
             if self._done:
+                if self._err:
+                    # a raced close() may have drained the sentinel that
+                    # carried the error: surface it, never swallow it
+                    raise self._err[0]
                 raise StopIteration
             try:
                 item = self._q.get(timeout=0.1)
             except queue.Empty:
+                if (self._err and not self._thread.is_alive()
+                        and self._q.empty()):
+                    # producer died (transfer/source raised) and its
+                    # sentinel was lost (e.g. drained by a concurrent
+                    # close, or the bounded put gave up): propagate the
+                    # exception instead of spinning on an empty queue
+                    self.close()
+                    raise self._err[0]
                 continue
             if item is _SENTINEL:
                 self.close()

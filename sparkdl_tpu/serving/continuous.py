@@ -33,6 +33,7 @@ from typing import Any, Optional
 import numpy as np
 
 from sparkdl_tpu.observability.tracing import span
+from sparkdl_tpu.runtime.dispatch import ChainPolicy, record_dispatch
 from sparkdl_tpu.serving.metrics import ServingMetrics
 from sparkdl_tpu.serving.queue import (
     DeadlineExceededError,
@@ -71,16 +72,31 @@ class ContinuousGPTEngine:
 
     ``auto_start=False`` exposes :meth:`tick` for deterministic
     single-step tests; the default runs the loop on a daemon thread.
+
+    ``chain_tokens`` fuses up to k decode steps into ONE device dispatch
+    (``lax.scan`` over the donated cache — runtime/dispatch.py): a
+    decode step is tiny next to the per-dispatch gap, so the unchained
+    loop pays a full dispatch *per generated token*. Chaining trades
+    admission/retirement granularity (checks run every k tokens, not
+    every token) for k-fold dispatch amortization; k is re-bounded every
+    tick by the smallest remaining token budget in flight (the earliest
+    possible retirement — nothing is decoded past it) and by the
+    tightest in-flight deadline over the measured per-token time, so
+    p99 latency does not regress. Greedy tokens are identical at any k.
+    None = auto-calibrate from the dispatch gap; 1 (default) = one
+    token per dispatch, the exact pre-chaining tick semantics.
     """
 
     def __init__(self, config, variables, *, n_slots: int = 8,
                  max_len: int = 512, max_queue_depth: int = 256,
                  eos_id: Optional[int] = None,
                  idle_wait_s: float = 0.005,
+                 chain_tokens: "int | None" = 1,
                  metrics: ServingMetrics | None = None,
                  auto_start: bool = True):
         import jax
         import jax.numpy as jnp
+        from jax import lax
 
         from sparkdl_tpu.models.gpt import (
             GPTLMHeadModel,
@@ -90,6 +106,10 @@ class ContinuousGPTEngine:
 
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if chain_tokens is not None and chain_tokens < 1:
+            raise ValueError(
+                f"chain_tokens must be >= 1, got {chain_tokens}"
+            )
         if (config.positions == "learned"
                 and max_len > config.max_seq_len):
             raise ValueError(
@@ -102,6 +122,14 @@ class ContinuousGPTEngine:
         self.max_len = max_len
         self.eos_id = eos_id
         self.idle_wait_s = idle_wait_s
+        self.chain_tokens = chain_tokens
+        self._chain_policy = ChainPolicy(
+            max_chain=chain_tokens if chain_tokens is not None else 32
+        )
+        if chain_tokens is None:
+            # auto mode reads the gap per tick: calibrate once here,
+            # outside the engine lock, never inside the decode loop
+            self._chain_policy.gap()
         self.queue = RequestQueue(max_depth=max_queue_depth)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self._model = GPTLMHeadModel(config)
@@ -163,9 +191,35 @@ class ContinuousGPTEngine:
             )
             return jnp.argmax(logits[:, -1], axis=-1), cache
 
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnums=(3,))
+        def _step_chain(variables, cache, tok, k, start):
+            # k tokens per dispatch: scan the single-step body carrying
+            # (cache, tok) — each step's argmax feeds the next, exactly
+            # the unchained sequence, amortizing the dispatch gap k-fold.
+            # The carried cache IS the iteration dependence (no CSE
+            # collapse possible) and rides the donated input buffer.
+            def body(carry, _):
+                cache, tok = carry
+                positions = (cache["idx"] - start)[:, None]
+                key_valid = (jnp.arange(max_len)[None, :]
+                             >= start[:, None])
+                logits, cache = model.apply(
+                    variables, tok[:, None], cache=cache,
+                    positions=positions, attention_mask=key_valid,
+                )
+                tok = jnp.argmax(logits[:, -1], axis=-1)
+                return (cache, tok), tok
+
+            (cache, _), toks = lax.scan(
+                body, (cache, tok), None, length=k
+            )
+            return toks, cache
+
         self._prefill_fn = _prefill
         self._scatter_fn = _scatter
         self._step_fn = _step
+        self._step_chain_fn = _step_chain
         if auto_start:
             self.start()
 
@@ -309,22 +363,76 @@ class ContinuousGPTEngine:
         if self._is_done(flight):  # max_new_tokens=1, or instant eos
             self._complete(slot)
 
+    def _decode_chain_len(self, now: float) -> int:
+        """Tokens to fuse into the next decode dispatch.
+
+        Bounded by (a) the configured/auto cap, (b) the smallest
+        remaining token budget in flight — the earliest possible
+        retirement, so no slot is held past its scheduled exit and no
+        decoded token is wasted on budget grounds — and (c) the tightest
+        in-flight deadline over the measured per-token time (2x safety),
+        so a request never expires inside a chain it could have survived.
+        Rounded down to a power of two: at most log2(cap) compiled chain
+        programs ever exist.
+        """
+        cap = (self.chain_tokens if self.chain_tokens is not None
+               else self._chain_policy.chain_len())
+        cap = min(cap, *(
+            f.max_new - len(f.produced) for f in self._inflight.values()
+        ))
+        tok_s = self._chain_policy.program_s
+        if tok_s:
+            for f in self._inflight.values():
+                if f.req.deadline is not None:
+                    headroom = (f.req.deadline - now) / (2.0 * tok_s)
+                    cap = min(cap, int(headroom))
+        elif any(f.req.deadline is not None
+                 for f in self._inflight.values()):
+            # no per-token estimate yet and a deadline is in flight: the
+            # first dispatch doubles as the measurement probe at k=1 so
+            # a request can never expire inside an unmeasured chain
+            return 1
+        if cap <= 1:
+            return 1
+        return 1 << (cap.bit_length() - 1)
+
     def _decode_step(self) -> None:
         import jax.numpy as jnp
 
-        with span("serving.decode_step", slots=len(self._inflight)):
-            tok, self._cache = self._step_fn(
-                self.variables, self._cache,
-                jnp.asarray(self._last_tok), jnp.asarray(self._start),
-            )
-            tok = np.asarray(tok)
+        k = self._decode_chain_len(time.monotonic())
+        t0 = time.perf_counter()
+        with span("serving.decode_step", slots=len(self._inflight),
+                  chain=k):
+            if k == 1:
+                tok, self._cache = self._step_fn(
+                    self.variables, self._cache,
+                    jnp.asarray(self._last_tok), jnp.asarray(self._start),
+                )
+                toks = np.asarray(tok)[None]
+            else:
+                toks, self._cache = self._step_chain_fn(
+                    self.variables, self._cache,
+                    jnp.asarray(self._last_tok), k,
+                    jnp.asarray(self._start),
+                )
+                toks = np.asarray(toks)
+        wall = time.perf_counter() - t0
+        record_dispatch("decode", k, wall)
+        self._chain_policy.record(wall, k)
         self.metrics.record_batch(len(self._inflight), self.n_slots)
-        for slot in list(self._inflight):
-            flight = self._inflight[slot]
-            flight.produced.append(int(tok[slot]))
-            self._last_tok[slot] = tok[slot]
-            if self._is_done(flight):
-                self._complete(slot)
+        for j in range(k):
+            live = [s for s in self._inflight]
+            if not live:
+                break
+            for slot in live:
+                flight = self._inflight[slot]
+                flight.produced.append(int(toks[j, slot]))
+                self._last_tok[slot] = toks[j, slot]
+                if self._is_done(flight):
+                    # eos (or budget) mid-chain: any later tokens the
+                    # chain decoded for this row are simply dropped —
+                    # rows are independent, so they influenced nobody
+                    self._complete(slot)
 
     def _is_done(self, flight: _InFlight) -> bool:
         return (len(flight.produced) >= flight.max_new

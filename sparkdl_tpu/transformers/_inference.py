@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 import weakref
 from typing import Any, Callable, Iterator
 
@@ -21,6 +22,11 @@ from sparkdl_tpu.runtime.batching import (
     default_buckets,
     pad_to_bucket,
     rebatch,
+)
+from sparkdl_tpu.runtime.dispatch import (
+    ChainPolicy,
+    ScanChainer,
+    record_dispatch,
 )
 from sparkdl_tpu.runtime.prefetch import prefetch_to_device
 
@@ -66,9 +72,31 @@ class BatchedRunner:
     #: None = auto (shard over local devices when there is more than one);
     #: False forces single-device; True demands >1 local device.
     data_parallel: "bool | None" = None
+    #: Fused multi-step dispatch (runtime/dispatch.py): chain this many
+    #: same-bucket batches per device dispatch in :meth:`run`. None =
+    #: auto (``SPARKDL_TPU_CHAIN_K`` env, else the ChainPolicy picks K
+    #: from measured program time vs the calibrated dispatch gap); 1
+    #: disables chaining. Outputs are bitwise-identical either way —
+    #: chaining is a dispatch decision, never a numeric one. Memory:
+    #: chaining holds up to K staged batches (auto caps K at 8) plus a
+    #: stacked [K, ...] copy inside the fused program — workloads whose
+    #: per-batch inputs already sit near the HBM limit should pass
+    #: ``chain_k=1`` (the chain buys nothing there anyway: big batches
+    #: mean long programs, where the policy degrades to K=1 itself).
+    chain_k: "int | None" = None
 
     def __post_init__(self):
-        self._jitted = jax.jit(self.apply_fn)
+        self._chainer = ScanChainer(
+            self.apply_fn, path="batch", chain_k=self.chain_k,
+            # auto mode holds K staged batches for the chain on top of
+            # the prefetch queue: cap auto-K at 8 so peak input memory
+            # stays bounded on unchanged caller code (PERF.md: K=8
+            # captures most of the measured dispatch win; an explicit
+            # chain_k raises the ceiling deliberately)
+            policy=ChainPolicy(max_chain=8),
+        )
+        # run_batch and the unchained run path share this executable
+        self._jitted = self._chainer.jit_single
         self._chunk = self.batch_size
         self._buckets = default_buckets(self.batch_size)
         self._sharding = None
@@ -137,14 +165,21 @@ class BatchedRunner:
                 yield b.arrays
 
         results = self._device_feed(host_batches())
-        for i, staged in enumerate(results):
+        # Fused dispatch: runs of same-bucket staged batches are chained
+        # K-per-dispatch (lax.scan inside one jit) behind the prefetch
+        # buffer; ragged tail buckets flush unchained. Output order and
+        # values are identical to the one-dispatch-per-batch loop.
+        # NOTE: the device step now lands in the chainer's
+        # ``dispatch.chain`` span (path="batch"); the old per-batch
+        # ``batch.device_step`` span would only time the host-side
+        # conversion of an already-materialized output here, so it is
+        # gone rather than left lying about where the time went.
+        for i, out in enumerate(self._chainer.map_stream(results)):
             n = metas[i]
-            with span("batch.device_step", rows=n):
-                out = self._jitted(staged)
-                if isinstance(out, (tuple, list)):
-                    arrays: Any = [np.asarray(o) for o in out]
-                else:
-                    arrays = np.asarray(out)
+            if isinstance(out, (tuple, list)):
+                arrays: Any = [np.asarray(o) for o in out]
+            else:
+                arrays = np.asarray(out)
             if isinstance(arrays, list):
                 for j in range(n):
                     yield tuple(a[j] for a in arrays)
@@ -165,9 +200,20 @@ class BatchedRunner:
             return
         keys = list(first)
 
-        def chained():
+        def stream():
             yield first
             yield from it
+
+        # a K-chain consumes K staged batches per dispatch, so the
+        # staging pipeline must run at least that far ahead or the chain
+        # assembly itself becomes the serialization point. The chainer's
+        # chain_k is the RESOLVED value (env override included); auto
+        # (None) sizes for the policy ceiling, since K can ramp there
+        # after the first measured dispatch.
+        depth = max(
+            self.prefetch,
+            self._chainer.chain_k or self._chainer.policy.max_chain,
+        )
 
         if native_available() and not self.ragged_rows:
             # struct-of-tensors slots: EVERY uniform feed rides the ring —
@@ -182,12 +228,12 @@ class BatchedRunner:
                 for k in keys
             }
             yield from DeviceFeeder(
-                chained(), n_slots=self.prefetch + 1, max_batch_bytes=seg,
+                stream(), n_slots=depth + 1, max_batch_bytes=seg,
                 transfer=self._transfer,
             )
             return
         yield from prefetch_to_device(
-            chained(), size=self.prefetch, transfer=self._transfer
+            stream(), size=depth, transfer=self._transfer
         )
 
     def run_batch(self, arrays: dict[str, np.ndarray]):
@@ -203,12 +249,21 @@ class BatchedRunner:
         just with 0 rows.
         """
         padded = pad_to_bucket(arrays, self._buckets)
+        t0 = time.perf_counter()
         with span("serving.device_step", rows=padded.n_valid,
                   bucket=padded.bucket):
+            # one request group = one dispatch, NEVER chained: chaining
+            # would couple unrelated requests' failure domains, and the
+            # micro-batcher already amortizes dispatch across riders
             out = self._jitted(self._transfer(padded.arrays))
             if isinstance(out, (tuple, list)):
-                return tuple(np.asarray(o)[: padded.n_valid] for o in out)
-            return np.asarray(out)[: padded.n_valid]
+                result: Any = tuple(
+                    np.asarray(o)[: padded.n_valid] for o in out
+                )
+            else:
+                result = np.asarray(out)[: padded.n_valid]
+        record_dispatch("serving", 1, time.perf_counter() - t0)
+        return result
 
     def _transfer(self, arrays: dict[str, np.ndarray]):
         if self._sharding is not None:

@@ -1,0 +1,86 @@
+"""prefetch_to_device error paths: a transfer that raises mid-stream must
+propagate to the consumer's ``__next__`` — delivered after the items that
+were already staged — and release the producer thread, never leaving the
+consumer blocked on the queue or the error silently swallowed.
+"""
+
+import time
+
+import pytest
+
+from sparkdl_tpu.runtime.prefetch import pipelined_map, prefetch_to_device
+
+
+class TransferBoom(RuntimeError):
+    pass
+
+
+def _flaky_transfer(fail_at):
+    def transfer(item):
+        if item == fail_at:
+            raise TransferBoom(f"transfer failed on item {item}")
+        return item * 10
+    return transfer
+
+
+def _wait_dead(it, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while it._thread.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    return not it._thread.is_alive()
+
+
+def test_transfer_error_mid_stream_propagates_in_order():
+    it = prefetch_to_device(iter(range(6)), size=2,
+                            transfer=_flaky_transfer(3))
+    got = []
+    with pytest.raises(TransferBoom, match="item 3"):
+        for x in it:  # must terminate: no hang on the queue
+            got.append(x)
+    assert got == [0, 10, 20]  # staged items delivered before the error
+    assert _wait_dead(it), "producer thread leaked after transfer error"
+
+
+def test_transfer_error_on_first_item():
+    it = prefetch_to_device(iter(range(4)), size=2,
+                            transfer=_flaky_transfer(0))
+    with pytest.raises(TransferBoom):
+        next(it)
+    assert _wait_dead(it)
+
+
+def test_source_iterator_error_propagates():
+    def source():
+        yield 1
+        raise TransferBoom("source died")
+
+    it = prefetch_to_device(source(), size=2, transfer=lambda x: x)
+    assert next(it) == 1
+    with pytest.raises(TransferBoom, match="source died"):
+        next(it)
+
+
+def test_error_survives_raced_close():
+    # close() drains the queue — which can swallow the sentinel that
+    # carried the error. __next__ must still raise it, not StopIteration.
+    it = prefetch_to_device(iter(range(3)), size=2,
+                            transfer=_flaky_transfer(0))
+    assert _wait_dead(it), "producer should die on the first transfer"
+    it.close()  # races/loses the sentinel: queue drained, _done set
+    with pytest.raises(TransferBoom):
+        next(it)
+
+
+def test_pipelined_map_propagates_transfer_error():
+    out = []
+    with pytest.raises(TransferBoom):
+        for y in pipelined_map(lambda x: x + 1, iter(range(5)),
+                               transfer=_flaky_transfer(2)):
+            out.append(y)
+    assert out == [1, 11]
+
+
+def test_clean_stream_unaffected():
+    it = prefetch_to_device(iter(range(5)), size=2, transfer=lambda x: -x)
+    assert list(it) == [0, -1, -2, -3, -4]
+    assert _wait_dead(it)

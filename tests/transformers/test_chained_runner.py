@@ -1,0 +1,131 @@
+"""BatchedRunner fused dispatch: chained (chain_k>1) outputs must be
+BITWISE identical to the unchained runner for every bucket pattern —
+full batches, ragged tails, empty streams — on both the single-device
+and the dp-sharded (8 fake chips) paths, while the dispatch counter
+drops ~K*.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.runtime.dispatch import dispatch_count
+from sparkdl_tpu.transformers._inference import BatchedRunner
+
+DIM = 12
+W = jnp.asarray(
+    np.random.default_rng(3).standard_normal((DIM, DIM)), jnp.float32
+) / DIM
+
+
+def _apply(batch):
+    h = batch["x"]
+    for _ in range(2):
+        h = jnp.tanh(h @ W)
+    return h
+
+
+def _rows(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"x": rng.standard_normal(DIM).astype(np.float32)}
+            for _ in range(n)]
+
+
+@pytest.mark.parametrize("k", [1, 4, 8])
+@pytest.mark.parametrize("n_rows", [64, 70])  # exact buckets + ragged tail
+def test_chained_bitwise_parity(k, n_rows):
+    rows = _rows(n_rows)
+    base = BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                         chain_k=1)
+    want = list(base.run(iter(rows)))
+    chained = BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                            chain_k=k)
+    got = list(chained.run(iter(rows)))
+    assert len(got) == n_rows
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_dispatch_count_drops_k_fold():
+    rows = _rows(64)  # 8 exact batches of 8
+    r1 = BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                       chain_k=1)
+    before = dispatch_count("batch")
+    list(r1.run(iter(rows)))
+    unchained = dispatch_count("batch") - before
+
+    r8 = BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                       chain_k=8)
+    before = dispatch_count("batch")
+    list(r8.run(iter(rows)))
+    chained = dispatch_count("batch") - before
+
+    assert unchained == 8
+    assert chained == 1
+
+
+def test_ragged_tail_and_small_stream():
+    # tail bucket smaller than the chain: flushed unchained, order kept
+    rows = _rows(19)  # 2 full batches of 8 + tail of 3 (bucket 8... pick)
+    base = list(BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                              chain_k=1).run(iter(rows)))
+    got = list(BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                             chain_k=4).run(iter(rows)))
+    for g, w in zip(got, base):
+        np.testing.assert_array_equal(g, w)
+    # stream shorter than one chain
+    short = _rows(5, seed=1)
+    base = list(BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                              chain_k=1).run(iter(short)))
+    got = list(BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                             chain_k=8).run(iter(short)))
+    assert len(got) == 5
+    for g, w in zip(got, base):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_empty_stream_and_empty_run_batch():
+    r = BatchedRunner(_apply, batch_size=8, data_parallel=False, chain_k=4)
+    assert list(r.run(iter([]))) == []
+    out = r.run_batch({"x": np.zeros((0, DIM), np.float32)})
+    assert out.shape[0] == 0  # empty serving flush still runs
+
+
+def test_chained_parity_on_dp_mesh():
+    # data_parallel auto: conftest exposes 8 fake devices, so batches run
+    # sharded — chaining must compose with the committed input sharding
+    assert jax.local_device_count() == 8
+    rows = _rows(48)
+    base = list(BatchedRunner(_apply, batch_size=16, chain_k=1)
+                .run(iter(rows)))
+    got = list(BatchedRunner(_apply, batch_size=16, chain_k=3)
+               .run(iter(rows)))
+    assert len(got) == 48
+    for g, w in zip(got, base):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_tuple_output_apply_fn_chained():
+    def multi(batch):
+        h = jnp.tanh(batch["x"] @ W)
+        return h, h.sum(axis=-1)
+
+    rows = _rows(16, seed=2)
+    base = list(BatchedRunner(multi, batch_size=8, data_parallel=False,
+                              chain_k=1).run(iter(rows)))
+    got = list(BatchedRunner(multi, batch_size=8, data_parallel=False,
+                             chain_k=2).run(iter(rows)))
+    for (g0, g1), (w0, w1) in zip(got, base):
+        np.testing.assert_array_equal(g0, w0)
+        np.testing.assert_array_equal(g1, w1)
+
+
+def test_serving_run_batch_stays_unchained():
+    # the serving one-shot path must count exactly one dispatch per call
+    # (per-request error isolation: no cross-request chaining)
+    r = BatchedRunner(_apply, batch_size=8, data_parallel=False, chain_k=8)
+    before = dispatch_count("serving")
+    r.run_batch({"x": np.zeros((3, DIM), np.float32)})
+    r.run_batch({"x": np.zeros((5, DIM), np.float32)})
+    assert dispatch_count("serving") - before == 2
