@@ -89,10 +89,36 @@ def main() -> None:
                     Image.open(io.BytesIO(raw)).resize((size, size)))
             yield {"image": arr}
 
+    from sparkdl_tpu.observability import registry
+
+    def _series(snap, name, field="value"):
+        fam = snap.get(name) or {}
+        vals = fam.get("values") or {}
+        series = vals.get("") or {}
+        if isinstance(series, dict):
+            return float(series.get("sum") or 0.0)
+        return float(series or 0.0)
+
+    def ring_telemetry(snap):
+        """Ring counters straight off the observability registry (ISSUE
+        4 satellite): the SAME series `/metrics` exposes, not bench-local
+        bookkeeping — slot waits (transfer/compute behind) and consumer
+        waits (infeed starvation) next to batches/bytes."""
+        return {
+            "batches": _series(snap, "sparkdl_ring_batches_total"),
+            "bytes": _series(snap, "sparkdl_ring_bytes_total"),
+            "slot_wait_s": _series(
+                snap, "sparkdl_ring_slot_wait_seconds_total"),
+            "consumer_wait_s": _series(
+                snap, "sparkdl_ring_consumer_wait_seconds"),
+            "prefetch_consumer_wait_s": _series(
+                snap, "sparkdl_prefetch_consumer_wait_seconds"),
+        }
+
     # warmup (compile every bucket it will see)
     list(runner.run({"image": np.zeros((size, size, 3), np.uint8)}
                     for _ in range(batch)))
-    stats0 = dict(bridge.FEED_STATS)
+    ring0 = ring_telemetry(registry().snapshot())
 
     t0 = time.perf_counter()
     n_out = 0
@@ -102,8 +128,13 @@ def main() -> None:
     dt = time.perf_counter() - t0
     assert n_out == n_images
 
-    ring_batches = bridge.FEED_STATS["ring_batches"] - stats0["ring_batches"]
-    ring_mb = (bridge.FEED_STATS["ring_bytes"] - stats0["ring_bytes"]) / 2**20
+    ring1 = ring_telemetry(registry().snapshot())
+    ring = {k: ring1[k] - ring0[k] for k in ring1}
+    ring_batches = int(ring["batches"])
+    ring_mb = ring["bytes"] / 2**20
+    # starvation share of this run's wall: how long the consumer sat
+    # waiting on the feed (ring or Python prefetch, whichever path ran)
+    starve_s = ring["consumer_wait_s"] + ring["prefetch_consumer_wait_s"]
     summary = meter.summary()
 
     # -- text variant: BERT featurization through the struct-of-tensors
@@ -161,12 +192,17 @@ def main() -> None:
         "native_decode": use_native_decode,
         "ring_batches": ring_batches,
         "ring_mb": round(ring_mb, 1),
+        # registry-sourced (ISSUE 4): the same series /metrics scrapes
+        "ring_slot_wait_s": round(ring["slot_wait_s"], 4),
+        "ring_consumer_wait_s": round(ring["consumer_wait_s"], 4),
+        "infeed_starvation_share": round(min(1.0, starve_s / dt), 4),
         "mfu": summary.get("mfu"),
         "infeed_starvation_pct": summary.get("infeed_starvation_pct"),
         "text_variant": {
             "texts_per_sec": round(n_texts / t_dt, 1),
             "rode_ring": bool(text_ring),
         },
+        "observability": registry().snapshot(),
     }))
 
 

@@ -24,7 +24,15 @@ per-token cost is dominated by reading the weights + KV cache).
 
 Env knobs: BENCH_REQUESTS (default 512), BENCH_MAX_BATCH (32),
 BENCH_RATE (req/s; default auto = 4x the measured batch-of-1 capacity),
-BENCH_FEATURES (768), BENCH_LAYERS (4).
+BENCH_FEATURES (768), BENCH_LAYERS (4), BENCH_REPLICAS (default 1:
+the micro engine serves through a ReplicaPool of N executors — on a
+CPU harness N virtual devices are forced so the routing/overlap is
+real, "simulated replicas" in ISSUE 4's sense).
+
+The JSON line also carries `fetch_wait_share` (host seconds blocked
+collecting async D2H results / measured wall — the number the async
+completion layer exists to shrink) and `replica_count` next to
+`dispatch_count`/`overhead_share`.
 """
 
 import json
@@ -61,6 +69,16 @@ def _replay(engine, arrivals):
 
 
 def main() -> None:
+    n_replicas = int(os.environ.get("BENCH_REPLICAS", "1"))
+    if (n_replicas > 1
+            and "xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        # simulated replicas on the CPU harness: one virtual device per
+        # replica, fixed before jax's first import
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_replicas}"
+        ).strip()
     import jax
 
     if os.environ.get("JAX_PLATFORMS"):
@@ -68,6 +86,7 @@ def main() -> None:
     import jax.numpy as jnp
 
     from sparkdl_tpu.serving import ServingEngine
+    from sparkdl_tpu.serving.replicas import ReplicaPool
     from sparkdl_tpu.transformers._inference import BatchedRunner
 
     platform = jax.default_backend()
@@ -86,7 +105,18 @@ def main() -> None:
             h = jnp.tanh(h @ w)
         return h
 
-    def make_engine(batch_size):
+    def make_engine(batch_size, replicas=1):
+        if replicas > 1:
+            pool = ReplicaPool(
+                apply_fn, batch_size=batch_size,
+                devices=jax.local_devices()[:replicas],
+            )
+            # compile every bucket on EVERY replica before measurement
+            for b in pool.replicas[0].runner._buckets:
+                pool.warmup({"x": np.zeros((b, dim), np.float32)})
+            return ServingEngine(
+                pool, max_queue_depth=max(n_req, 8), max_wait_s=0.002,
+            )
         runner = BatchedRunner(apply_fn, batch_size=batch_size,
                                data_parallel=False)
         # compile every bucket BEFORE measurement: steady-state serving is
@@ -117,9 +147,16 @@ def main() -> None:
     n_b1, dur_b1, p50_b1, p95_b1, _ = _replay(b1, arrivals)
     b1.close()
 
-    micro = make_engine(max_batch)
+    from sparkdl_tpu.runtime.completion import fetch_wait_seconds
+
+    micro = make_engine(max_batch, replicas=n_replicas)
+    fetch_wait0 = fetch_wait_seconds("serving")
     n_mb, dur_mb, p50_mb, p95_mb, occ = _replay(micro, arrivals)
+    fetch_wait = fetch_wait_seconds("serving") - fetch_wait0
+    replica_snap = micro.snapshot()
     micro.close()
+    if n_replicas > 1:
+        micro.runner.close()
 
     tput_b1 = n_b1 / dur_b1
     tput_mb = n_mb / dur_mb
@@ -157,6 +194,11 @@ def main() -> None:
         "dispatch_count": n_dispatches,
         "dispatch_gap_ms": round(gap * 1e3, 4),
         "overhead_share": round(share, 4) if share is not None else None,
+        # async completion (ISSUE 4): host share of the micro run's wall
+        # spent blocked collecting D2H results — the overlap headroom
+        "fetch_wait_share": round(min(1.0, fetch_wait / dur_mb), 4),
+        "replica_count": replica_snap.get("replica_count", 1),
+        "replicas": replica_snap.get("replicas"),
         "observability": registry().snapshot(),
     }))
 

@@ -56,6 +56,70 @@ for g, b in zip(got, base):
     np.testing.assert_array_equal(g, b)
 print("fused-dispatch smoke OK: 8 dispatches -> 1 at K=8, bitwise equal")
 '
+# Async-completion smoke (ISSUE 4): the pipelined readback must keep at
+# most `window` results in flight and match the blocking readback
+# bitwise on a chained runner.
+JAX_PLATFORMS=cpu python -c '
+import numpy as np, jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.runtime.completion import AsyncFetcher
+from sparkdl_tpu.transformers._inference import BatchedRunner
+
+# window bound: pulls may never run more than `window` ahead of yields
+pulled = 0
+def source():
+    global pulled
+    for i in range(24):
+        pulled += 1
+        yield np.full((2,), float(i))
+yielded = 0
+for out in AsyncFetcher(window=4, path="smoke").stream(source()):
+    np.testing.assert_array_equal(out, np.full((2,), float(yielded)))
+    yielded += 1
+    assert pulled - yielded <= 4, (pulled, yielded)
+assert yielded == 24
+
+# bitwise parity: async (default) vs blocking readback, chained K=8
+w = jnp.asarray(np.random.default_rng(0).standard_normal((8, 8)), jnp.float32)
+rows = [{"x": np.random.default_rng(i).standard_normal(8).astype(np.float32)}
+        for i in range(32)]
+base = list(BatchedRunner(lambda b: jnp.tanh(b["x"] @ w), batch_size=4,
+                          data_parallel=False, chain_k=8,
+                          async_fetch=False).run(iter(rows)))
+got = list(BatchedRunner(lambda b: jnp.tanh(b["x"] @ w), batch_size=4,
+                         data_parallel=False, chain_k=8).run(iter(rows)))
+for g, b in zip(got, base):
+    np.testing.assert_array_equal(g, b)
+print("async-completion smoke OK: <=4 in flight, bitwise equal at K=8")
+'
+# Replica-pool smoke (ISSUE 4): a 2-replica CPU pool serves a burst with
+# BOTH replicas receiving work, then drains to zero depth.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 python -c '
+import numpy as np, jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.serving import ReplicaPool, ServingEngine
+w = jnp.asarray(np.random.default_rng(0).standard_normal((8, 8)), jnp.float32)
+pool = ReplicaPool(lambda b: jnp.tanh(b["x"] @ w), batch_size=8)
+assert len(pool.replicas) == 2, len(pool.replicas)
+pool.warmup({"x": np.zeros((8, 8), np.float32)})
+with ServingEngine(pool, max_wait_s=0.002) as eng:
+    futs = [eng.submit({"x": np.full((8,), float(i), np.float32)})
+            for i in range(64)]
+    for i, f in enumerate(futs):
+        np.testing.assert_allclose(
+            f.result(timeout=60),
+            np.tanh(np.full((8,), float(i), np.float32) @ np.asarray(w)),
+            rtol=1e-5)
+    snap = eng.snapshot()
+pool.close()
+assert snap["replica_count"] == 2, snap
+served = [r["dispatched"] for r in snap["replicas"]]
+assert all(d > 1 for d in served), served  # burst hit BOTH replicas
+assert all(r["depth"] == 0 and r["in_flight"] == 0
+           for r in snap["replicas"]), snap["replicas"]
+print("replica-pool smoke OK: burst over 2 replicas", served,
+      "drained to zero depth")
+'
 # Local multi-chip DP hook: same contract, batch sharded over 8 fake chips.
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   BENCH_STEPS=2 BENCH_BATCH=8 BENCH_DP_DEVICES=8 python bench.py | tail -1 | python -c '
@@ -81,6 +145,10 @@ for key in ("sparkdl_queue_submitted_total", "sparkdl_serving_requests_total",
 # ISSUE 3: serving dispatches counted + overhead share attributed
 assert rec["dispatch_count"] > 0, rec
 assert "sparkdl_dispatch_seconds" in obs, sorted(obs)
+# ISSUE 4: async-completion + replica fields ride the artifact
+assert 0 <= rec["fetch_wait_share"] <= 1, rec["fetch_wait_share"]
+assert rec["replica_count"] == 1, rec["replica_count"]
+assert "sparkdl_fetch_wait_seconds" in obs, sorted(obs)
 print("bench_serving contract OK (snapshot embedded)")
 '
 

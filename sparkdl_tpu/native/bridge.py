@@ -19,6 +19,7 @@ from __future__ import annotations
 import ctypes
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import numpy as np
@@ -32,14 +33,49 @@ def native_available() -> bool:
 
 #: process-wide feeder telemetry: how many streams rode the native ring vs
 #: the python fallback, and batches/bytes through the ring. Read by tests
-#: (the "does the hot path actually traverse the ring" proof) and by
-#: bench_hostfed's report.
+#: (the "does the hot path actually traverse the ring" proof); the
+#: observability registry mirrors (below) are the operator surface —
+#: `/metrics` sees DeviceFeeder starvation the same way it already sees
+#: prefetch starvation.
 FEED_STATS = {
     "ring_streams": 0,
     "fallback_streams": 0,
     "ring_batches": 0,
     "ring_bytes": 0,
 }
+
+_METRICS = None
+
+
+def _ring_metrics():
+    """Lazy registry handles for the staging-ring spine (kept off the
+    import path — this module must import without the observability
+    package warmed up): (batches counter, bytes counter, slot-wait
+    counter [packer blocked on a free slot = the transfer/compute side
+    is the bottleneck], consumer-wait histogram [consumer blocked on the
+    ring output = infeed starvation, same meaning as
+    ``sparkdl_prefetch_consumer_wait_seconds`` on the Python path])."""
+    global _METRICS
+    if _METRICS is None:
+        from sparkdl_tpu.observability.registry import registry
+
+        _METRICS = (
+            registry().counter(
+                "sparkdl_ring_batches_total",
+                "batches staged through the native ring"),
+            registry().counter(
+                "sparkdl_ring_bytes_total",
+                "bytes staged through the native ring"),
+            registry().counter(
+                "sparkdl_ring_slot_wait_seconds_total",
+                "packer time blocked waiting for a free ring slot "
+                "(device/transfer side is the bottleneck)"),
+            registry().histogram(
+                "sparkdl_ring_consumer_wait_seconds",
+                "consumer time blocked on the ring output queue "
+                "(infeed starvation)"),
+        )
+    return _METRICS
 
 
 # ---------------------------------------------------------------------------
@@ -301,6 +337,9 @@ class DeviceFeeder:
         errors: list[BaseException] = []
         SENTINEL = object()
 
+        ring_batches_m, ring_bytes_m, slot_wait_m, consumer_wait_m = (
+            _ring_metrics())
+
         def packer():
             try:
                 for raw in self._chain(first, it):
@@ -320,9 +359,15 @@ class DeviceFeeder:
                                 "BatchedRunner feeds)."
                             )
                         total += batch[k].nbytes
-                    idx = None
-                    while idx is None and not stop.is_set():
-                        idx = ring.acquire_write(timeout_s=0.1)
+                    idx = ring.acquire_write(timeout_s=0.0)
+                    if idx is None:
+                        # no free slot: the transfer/compute side is
+                        # behind — meter the stall so it shows in
+                        # /metrics next to prefetch producer blocking
+                        blocked_from = time.monotonic()
+                        while idx is None and not stop.is_set():
+                            idx = ring.acquire_write(timeout_s=0.1)
+                        slot_wait_m.inc(time.monotonic() - blocked_from)
                     if idx is None:
                         return
                     view = ring.slot_view(idx)
@@ -339,6 +384,8 @@ class DeviceFeeder:
                     )
                     FEED_STATS["ring_batches"] += 1
                     FEED_STATS["ring_bytes"] += total
+                    ring_batches_m.inc()
+                    ring_bytes_m.inc(total)
             except BaseException as e:
                 errors.append(e)
             finally:
@@ -401,7 +448,11 @@ class DeviceFeeder:
         t2.start()
         try:
             while True:
+                t_wait = time.monotonic()
                 item = out_q.get()
+                # consumer blocked on the feed = infeed starvation, the
+                # ring-path twin of sparkdl_prefetch_consumer_wait_seconds
+                consumer_wait_m.observe(time.monotonic() - t_wait)
                 if item is SENTINEL:
                     if errors:
                         raise errors[0]

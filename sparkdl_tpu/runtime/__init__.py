@@ -14,6 +14,11 @@ from sparkdl_tpu.runtime.batching import (
     pad_to_bucket,
     rebatch,
 )
+from sparkdl_tpu.runtime.completion import (
+    AsyncFetcher,
+    FetchTicket,
+    start_fetch,
+)
 from sparkdl_tpu.runtime.dispatch import (
     ChainPolicy,
     ScanChainer,
@@ -29,9 +34,11 @@ from sparkdl_tpu.runtime.prefetch import (
 
 __all__ = [
     "AXIS_ORDER",
+    "AsyncFetcher",
     "ChainPolicy",
     "DtypePolicy",
     "FLOAT32",
+    "FetchTicket",
     "MeshSpec",
     "PaddedBatch",
     "PrefetchIterator",
@@ -50,4 +57,5 @@ __all__ = [
     "rebatch",
     "replicated_sharding",
     "single_device_mesh",
+    "start_fetch",
 ]

@@ -20,7 +20,12 @@ Three layers, separately testable:
 - :mod:`~sparkdl_tpu.serving.continuous` — continuous batching for GPT
   generation over a per-slot KV cache: finished rows free their slot
   mid-stream, new prompts join the in-flight decode batch, greedy tokens
-  stay identical to the unbatched decode.
+  stay identical to the unbatched decode;
+- :mod:`~sparkdl_tpu.serving.replicas` — multi-device replica serving:
+  one pinned jit-cached executor per local chip, micro-batches routed
+  whole by least outstanding work, quarantine-on-repeated-failure, with
+  readback pipelined through :mod:`~sparkdl_tpu.runtime.completion` so
+  N chips serve N batches concurrently.
 
 Observability (:mod:`~sparkdl_tpu.serving.metrics`): queue depth, batch
 occupancy %, admission rejects, and p50/p95/p99 request latency via the
@@ -38,14 +43,20 @@ from sparkdl_tpu.serving.queue import (
     Request,
     RequestQueue,
 )
+from sparkdl_tpu.serving.replicas import (
+    AllReplicasQuarantinedError,
+    ReplicaPool,
+)
 
 __all__ = [
+    "AllReplicasQuarantinedError",
     "ContinuousGPTEngine",
     "DeadlineExceededError",
     "EngineClosedError",
     "GenRequest",
     "MicroBatcher",
     "QueueFullError",
+    "ReplicaPool",
     "Request",
     "RequestQueue",
     "ServingEngine",

@@ -33,6 +33,7 @@ from typing import Any, Optional
 import numpy as np
 
 from sparkdl_tpu.observability.tracing import span
+from sparkdl_tpu.runtime.completion import start_fetch
 from sparkdl_tpu.runtime.dispatch import ChainPolicy, record_dispatch
 from sparkdl_tpu.serving.metrics import ServingMetrics
 from sparkdl_tpu.serving.queue import (
@@ -403,19 +404,33 @@ class ContinuousGPTEngine:
         t0 = time.perf_counter()
         with span("serving.decode_step", slots=len(self._inflight),
                   chain=k):
+            # Async token readback (runtime/completion.py): the D2H copy
+            # of the token ids is enqueued the moment the decode dispatch
+            # is — it rides behind the compute instead of waiting for the
+            # host to come back with a blocking np.asarray after the
+            # program retires (one relay RTT saved per decode dispatch).
+            # block_until_ready splits compute from collection so
+            # sparkdl_fetch_wait_seconds{path="decode"} meters ONLY the
+            # residual copy wait, not the decode program itself.
+            import jax
+
             if k == 1:
                 tok, self._cache = self._step_fn(
                     self.variables, self._cache,
                     jnp.asarray(self._last_tok), jnp.asarray(self._start),
                 )
-                toks = np.asarray(tok)[None]
+                fetch = start_fetch(tok, path="decode")
+                jax.block_until_ready(tok)
+                toks = np.asarray(fetch.result())[None]
             else:
                 toks, self._cache = self._step_chain_fn(
                     self.variables, self._cache,
                     jnp.asarray(self._last_tok), k,
                     jnp.asarray(self._start),
                 )
-                toks = np.asarray(toks)
+                fetch = start_fetch(toks, path="decode")
+                jax.block_until_ready(toks)
+                toks = np.asarray(fetch.result())
         wall = time.perf_counter() - t0
         record_dispatch("decode", k, wall)
         self._chain_policy.record(wall, k)

@@ -9,10 +9,11 @@ One object to construct, one method to call::
     y = fut.result(timeout=1.0)              # one output row
 
 Requests coalesce into bucketed device batches (dp-sharded on multi-chip
-hosts — whatever the wrapped BatchedRunner compiled); overload rejects at
-admission (QueueFullError), deadlines cancel mid-queue
-(DeadlineExceededError), and ``close(drain=True)`` serves every admitted
-request before stopping.
+hosts — whatever the wrapped BatchedRunner compiled; or routed whole
+over a :class:`~sparkdl_tpu.serving.replicas.ReplicaPool` of per-device
+executors); overload rejects at admission (QueueFullError), deadlines
+cancel mid-queue (DeadlineExceededError), and ``close(drain=True)``
+serves every admitted request before stopping.
 """
 
 from __future__ import annotations
@@ -30,14 +31,16 @@ from sparkdl_tpu.transformers._inference import BatchedRunner
 
 
 class ServingEngine:
-    """Online micro-batching inference over a :class:`BatchedRunner`.
+    """Online micro-batching inference over a :class:`BatchedRunner` or
+    a :class:`~sparkdl_tpu.serving.replicas.ReplicaPool` (anything with
+    the ``run_batch``/``run_batch_async``/``chunk_size`` surface).
 
     ``max_wait_s`` bounds the extra latency the FIRST request of a batch
     pays to pick up riders; ``max_queue_depth`` bounds host memory and
     turns overload into fast rejects instead of unbounded tail latency.
     """
 
-    def __init__(self, runner: BatchedRunner, *,
+    def __init__(self, runner: "BatchedRunner | Any", *,
                  max_queue_depth: int = 256,
                  max_wait_s: float = 0.005,
                  extract: Callable[[Any], dict[str, np.ndarray]] | None = None,
@@ -45,6 +48,7 @@ class ServingEngine:
         # Opt-in observability endpoint (SPARKDL_TPU_METRICS_PORT):
         # idempotent, so every engine in the process shares one server.
         maybe_start_metrics_server()
+        self.runner = runner
         self.queue = RequestQueue(max_depth=max_queue_depth)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.batcher = MicroBatcher(
@@ -66,8 +70,15 @@ class ServingEngine:
 
     def snapshot(self) -> dict:
         """Operator metrics: queue depth, occupancy, latency p50/p95/p99,
-        admission counters."""
-        return self.metrics.snapshot(self.queue)
+        admission counters — plus per-replica depth/in-flight/quarantine
+        state when the runner is a ReplicaPool."""
+        snap = self.metrics.snapshot(self.queue)
+        pool_snapshot = getattr(self.runner, "snapshot", None)
+        if callable(pool_snapshot):
+            snap.update(pool_snapshot())
+        else:
+            snap["replica_count"] = 1
+        return snap
 
     def __enter__(self) -> "ServingEngine":
         return self

@@ -1,12 +1,22 @@
-"""Dynamic micro-batching dispatch loop over a BatchedRunner.
+"""Dynamic micro-batching dispatch loop over a BatchedRunner/ReplicaPool.
 
 The chip-saturation half of the serving engine: individual requests (one
 row each) coalesce into the bucketed, jit-cached device batches the batch
 pipeline already compiles (``transformers/_inference.BatchedRunner`` —
-including its automatic dp sharding on multi-chip hosts). Policy is the
-classic max-wait/max-batch: the first request in an empty queue waits at
-most ``max_wait_s`` before dispatch; every request that arrives in that
-window rides the same device program for free.
+including its automatic dp sharding on multi-chip hosts, or a
+``serving/replicas.ReplicaPool`` routing whole micro-batches over one
+pinned executor per chip). Policy is the classic max-wait/max-batch: the
+first request in an empty queue waits at most ``max_wait_s`` before
+dispatch; every request that arrives in that window rides the same
+device program for free.
+
+Completion is pipelined (ISSUE 4): when the runner exposes
+``run_batch_async`` (both BatchedRunner and ReplicaPool do), the loop
+dispatches micro-batch i+1 while micro-batch i's device→host readback is
+still in flight, resolving up to ``max_inflight_batches`` outstanding
+dispatches in submission order — assembly and readback hide behind
+compute instead of serializing with it, and on a replica pool the
+in-flight window is what keeps N chips busy at once.
 
 Robustness contract: a bad request degrades to ITS error, never the
 batch's. Extraction failures (shared :func:`try_extract` convention) fail
@@ -17,6 +27,7 @@ get results.
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
@@ -33,24 +44,55 @@ from sparkdl_tpu.transformers._inference import BatchedRunner, try_extract
 _log = logging.getLogger(__name__)
 
 
+class _Resolved:
+    """Future surface over an already-computed sync ``run_batch`` result
+    (the fallback for runner objects without ``run_batch_async``)."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value: Any):
+        self._value = value
+
+    def result(self, timeout: "float | None" = None) -> Any:
+        return self._value
+
+
 class MicroBatcher:
-    """Drains a :class:`RequestQueue` into ``runner.run_batch`` dispatches.
+    """Drains a :class:`RequestQueue` into ``runner.run_batch*`` dispatches.
 
     ``extract`` (optional) maps a request payload to the feature dict the
     runner eats — same role as the partition path's extract, same
     per-row-error semantics. Without it, payloads must already be feature
     dicts of per-row arrays (no batch dim; the batcher stacks).
+
+    ``max_inflight`` bounds how many dispatched-but-unresolved
+    micro-batches the loop keeps in flight (None = the runner's
+    ``max_inflight_batches``: 2 for a single async runner, healthy
+    replicas + 1 for a pool). 1 restores the strictly serial
+    dispatch-then-resolve loop.
     """
 
     def __init__(self, queue: RequestQueue, runner: BatchedRunner, *,
                  max_wait_s: float = 0.005,
                  extract: Callable[[Any], dict[str, np.ndarray]] | None = None,
-                 metrics: ServingMetrics | None = None):
+                 metrics: ServingMetrics | None = None,
+                 max_inflight: "int | None" = None):
+        if max_inflight is not None and max_inflight < 1:
+            raise ValueError(
+                f"max_inflight must be >= 1, got {max_inflight}"
+            )
         self.queue = queue
         self.runner = runner
         self.max_wait_s = max_wait_s
         self.extract = extract
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.max_inflight = (
+            max_inflight if max_inflight is not None
+            else max(1, getattr(runner, "max_inflight_batches", 1))
+        )
+        #: dispatched, unresolved batches: (live requests, feeds, future,
+        #: trace ctx) in submission order
+        self._pending: "collections.deque[tuple]" = collections.deque()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -81,15 +123,33 @@ class MicroBatcher:
                 if not reqs:
                     break
                 self._dispatch(reqs)
+            self._resolve_pending(0)
         self._stop.set()
         # a timed-out join or crashed loop may leave queued requests
         # behind: no Future may ever be left unresolved
+        self._fail_inflight()
         self.queue.fail_pending()
 
     # -- dispatch ------------------------------------------------------------
     def _loop(self) -> None:
         try:
             while not self._stop.is_set():
+                if self._pending:
+                    # Batches in flight: dispatch ahead ONLY when a full
+                    # bucket is already queued (or we are draining) —
+                    # otherwise collect the oldest readback first, so the
+                    # coalescing window keeps filling underneath exactly
+                    # as it did when the dispatch itself blocked. Without
+                    # this, pipelining would eagerly grab 2-row batches
+                    # and trade occupancy for depth.
+                    if (self.queue.closed
+                            or self.queue.depth >= self.runner.chunk_size):
+                        reqs = self.queue.take(self.runner.chunk_size, 0.0)
+                        if reqs:
+                            self._dispatch(reqs)
+                            continue
+                    self._resolve_pending(len(self._pending) - 1)
+                    continue
                 reqs = self.queue.take(self.runner.chunk_size,
                                        self.max_wait_s)
                 if not reqs:
@@ -103,8 +163,11 @@ class MicroBatcher:
             exc = (e if isinstance(e, Exception)
                    else RuntimeError(f"micro-batcher loop died: {e!r}"))
             self.queue.close()
+            self._fail_inflight(exc)
             self.queue.fail_pending(exc)
             raise
+        else:
+            self._resolve_pending(0)
 
     def _dispatch(self, reqs: list[Request]) -> None:
         # The worker thread has no ambient span; re-root on the first
@@ -114,9 +177,10 @@ class MicroBatcher:
             (r.trace_ctx for r in reqs if r.trace_ctx is not None), None
         )
         with tracing.attach(batch_ctx):
-            self._dispatch_traced(reqs)
+            self._dispatch_traced(reqs, batch_ctx)
 
-    def _dispatch_traced(self, reqs: list[Request]) -> None:
+    def _dispatch_traced(self, reqs: list[Request],
+                         batch_ctx) -> None:
         feeds: list[dict[str, np.ndarray]] = []
         live: list[Request] = []
         with span("serving.batch_assemble", requests=len(reqs)):
@@ -132,38 +196,77 @@ class MicroBatcher:
         if not live:
             return
         try:
-            outs = self._run(feeds)
+            fut = self._submit(feeds)
         except Exception as e:
-            if len(live) == 1:
-                self._finish(live[0], error=e)
-                return
-            # poison-row fallback: one bad row must not take down its
-            # batch-mates — retry each row alone, only the culprit errors
-            _log.warning(
-                "batch of %d failed; retrying per-row", len(live),
-                exc_info=True,
-            )
-            for req, feed in zip(live, feeds):
-                # each retry is a real device dispatch: count it, at its
-                # honest 1-row occupancy, so a poison-row storm shows up
-                # in the metrics instead of hiding behind them
-                self.metrics.record_batch(1, self.runner.chunk_size)
-                try:
-                    out = self._run([feed])
-                    self._finish(req, result=_row(out, 0))
-                except Exception as row_e:
-                    self._finish(req, error=row_e)
+            self._complete_failed(live, feeds, e)
             return
-        self.metrics.record_batch(len(live), self.runner.chunk_size)
-        for i, req in enumerate(live):
-            self._finish(req, result=_row(outs, i))
+        self._pending.append((live, feeds, fut, batch_ctx))
+        self._resolve_pending(self.max_inflight - 1)
 
-    def _run(self, feeds: list[dict[str, np.ndarray]]):
+    def _submit(self, feeds: list[dict[str, np.ndarray]]):
+        """Stack + dispatch one micro-batch; returns a result future.
+        Async when the runner supports it (the readback then overlaps
+        the next assembly/dispatch), degrading to an already-resolved
+        wrapper around the blocking call otherwise."""
         keys = feeds[0].keys()
         if any(f.keys() != keys for f in feeds):
             raise ValueError("requests disagree on feature keys")
         arrays = {k: np.stack([np.asarray(f[k]) for f in feeds]) for k in keys}
-        return self.runner.run_batch(arrays)
+        submit_async = getattr(self.runner, "run_batch_async", None)
+        if submit_async is not None:
+            return submit_async(arrays)
+        return _Resolved(self.runner.run_batch(arrays))
+
+    def _resolve_pending(self, limit: int) -> None:
+        """Collect completed dispatches (submission order) until at most
+        ``limit`` stay in flight."""
+        while len(self._pending) > limit:
+            live, feeds, fut, ctx = self._pending.popleft()
+            with tracing.attach(ctx):
+                try:
+                    outs = fut.result()
+                except Exception as e:
+                    self._complete_failed(live, feeds, e)
+                    continue
+                self.metrics.record_batch(len(live), self.runner.chunk_size)
+                for i, req in enumerate(live):
+                    self._finish(req, result=_row(outs, i))
+
+    def _complete_failed(self, live: list[Request],
+                         feeds: list[dict[str, np.ndarray]],
+                         e: Exception) -> None:
+        if len(live) == 1:
+            self._finish(live[0], error=e)
+            return
+        # poison-row fallback: one bad row must not take down its
+        # batch-mates — retry each row alone, only the culprit errors
+        _log.warning(
+            "batch of %d failed; retrying per-row", len(live),
+            exc_info=True,
+        )
+        for req, feed in zip(live, feeds):
+            # each retry is a real device dispatch: count it, at its
+            # honest 1-row occupancy, so a poison-row storm shows up
+            # in the metrics instead of hiding behind them
+            self.metrics.record_batch(1, self.runner.chunk_size)
+            try:
+                out = self._submit([feed]).result()
+                self._finish(req, result=_row(out, 0))
+            except Exception as row_e:
+                self._finish(req, error=row_e)
+
+    def _fail_inflight(self, exc: "Exception | None" = None) -> None:
+        """Fail every dispatched-but-unresolved request (crashed loop /
+        watchdog shutdown): no Future may be left unresolved."""
+        if exc is None:
+            from sparkdl_tpu.serving.queue import EngineClosedError
+
+            exc = EngineClosedError("engine shut down mid-dispatch")
+        while self._pending:
+            live, _, fut, _ = self._pending.popleft()
+            for req in live:
+                if not req.future.done():
+                    self._finish(req, error=exc)
 
     def _finish(self, req: Request, *, result: Any = None,
                 error: Exception | None = None) -> None:

@@ -1,0 +1,398 @@
+"""Multi-device replica serving: one jit-cached executor per local chip.
+
+``BatchedRunner``'s automatic data parallelism splits ONE batch across
+the local devices — right for throughput-bound batch jobs, wrong for
+online serving, where micro-batches are small (splitting a 32-row batch
+8 ways leaves every chip at 4-row occupancy) and the serialization point
+is the single dispatch loop. A :class:`ReplicaPool` is the replicated
+alternative (the replicated-execution design of TensorFlow, Abadi et
+al., applied to the serving stack): each local device gets its OWN
+pinned :class:`~sparkdl_tpu.transformers._inference.BatchedRunner` —
+own jit cache, own buckets, own ChainPolicy — and assembled
+micro-batches are routed whole to the replica with the least
+outstanding work. N chips serve N micro-batches concurrently; outputs
+stay bitwise identical to the single-device engine because every
+replica runs the exact same jitted program.
+
+Contracts:
+
+- **Routing**: least-outstanding-work (queued + running batches), ties
+  broken round-robin. Per-replica depth/latency land in the metrics
+  spine (``sparkdl_replica_depth{replica=...}``,
+  ``sparkdl_replica_batch_seconds{replica=...}``).
+- **Failure isolation**: a failed batch surfaces ITS error on ITS
+  future (the micro-batcher's poison-row fallback then retries rows
+  individually — routed to healthy replicas). ``max_failures``
+  *consecutive* executor failures quarantine the replica: it stops
+  taking work, its queue re-routes, and the pool keeps serving on the
+  survivors. Only an all-replicas-quarantined pool refuses work.
+- **Drain**: ``close(drain=True)`` serves every accepted batch before
+  stopping; ``drain=False`` fails queued batches immediately.
+
+Drop-in: the pool exposes ``run_batch`` / ``run_batch_async`` /
+``chunk_size``, so ``ServingEngine(ReplicaPool(...))`` works unchanged
+— the micro-batcher keeps up to ``max_inflight_batches`` (= healthy
+replicas + 1) dispatches in flight so every chip stays busy.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+import time
+from concurrent.futures import TimeoutError as FuturesTimeoutError
+from typing import Any, Callable
+
+import numpy as np
+
+from sparkdl_tpu.observability.metrics import StepMeter
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.observability.tracing import span
+from sparkdl_tpu.transformers._inference import BatchedRunner
+
+__all__ = ["AllReplicasQuarantinedError", "ReplicaPool"]
+
+_log = logging.getLogger(__name__)
+
+_METRICS = None
+
+
+def _metrics():
+    """Lazy spine handles: (depth gauge, batch-wall histogram, batches
+    counter, quarantine counter), all labelled by replica index."""
+    global _METRICS
+    if _METRICS is None:
+        _METRICS = (
+            registry().gauge(
+                "sparkdl_replica_depth",
+                "batches queued+running on each serving replica",
+                labels=("replica",)),
+            registry().histogram(
+                "sparkdl_replica_batch_seconds",
+                "per-replica batch wall time, dispatch to host result",
+                labels=("replica",)),
+            registry().counter(
+                "sparkdl_replica_batches_total",
+                "batches served by each replica", labels=("replica",)),
+            registry().counter(
+                "sparkdl_replica_quarantined_total",
+                "replicas quarantined after repeated executor failures"),
+        )
+    return _METRICS
+
+
+class AllReplicasQuarantinedError(RuntimeError):
+    """Every replica in the pool has been quarantined; the pool cannot
+    accept work until it is rebuilt."""
+
+
+class _Work:
+    """One routed micro-batch: arrays in, Future-like out."""
+
+    __slots__ = ("arrays", "result", "exc", "done")
+
+    def __init__(self, arrays: dict[str, np.ndarray]):
+        self.arrays = arrays
+        self.result: Any = None
+        self.exc: "BaseException | None" = None
+        self.done = threading.Event()
+
+    # Future-like surface (what MicroBatcher/BatchResult callers use)
+    def wait_result(self, timeout: "float | None" = None):
+        if not self.done.wait(timeout):
+            # same exception type BatchResult raises (they are distinct
+            # classes on 3.10): pool and single-runner futures must be
+            # interchangeable to caller retry logic
+            raise FuturesTimeoutError("replica batch still in flight")
+        if self.exc is not None:
+            raise self.exc
+        return self.result
+
+
+class _PoolFuture:
+    """Caller handle for one pool dispatch (matches
+    :class:`~sparkdl_tpu.transformers._inference.BatchResult`'s
+    ``result()`` surface)."""
+
+    __slots__ = ("_work",)
+
+    def __init__(self, work: _Work):
+        self._work = work
+
+    def result(self, timeout: "float | None" = None):
+        return self._work.wait_result(timeout)
+
+
+class _Replica:
+    """One device's executor: pinned runner + worker thread + queue."""
+
+    def __init__(self, index: int, device: Any, runner: BatchedRunner,
+                 pool: "ReplicaPool"):
+        self.index = index
+        self.device = device
+        self.runner = runner
+        self.pool = pool
+        self.queue: "queue_mod.Queue[_Work | None]" = queue_mod.Queue()
+        #: queued + running batches (the routing signal), under pool lock
+        self.outstanding = 0
+        self.dispatched = 0
+        self.consecutive_failures = 0
+        self.quarantined = False
+        self.latency = StepMeter(n_chips=1, window=256, warmup_steps=0)
+        self.thread = threading.Thread(
+            target=self._loop, name=f"sparkdl-replica-{index}", daemon=True
+        )
+        self.thread.start()
+
+    def _loop(self) -> None:
+        depth, wall_hist, batches, _ = _metrics()
+        label = str(self.index)
+        while True:
+            work = self.queue.get()
+            if work is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                with span("serving.replica_batch", replica=self.index):
+                    work.result = self.runner.run_batch(work.arrays)
+            except BaseException as e:
+                work.exc = e if isinstance(e, Exception) else RuntimeError(
+                    f"replica {self.index} executor died: {e!r}"
+                )
+                self.pool._on_failure(self)
+            else:
+                self.pool._on_success(self)
+            finally:
+                wall = time.perf_counter() - t0
+                wall_hist.observe(wall, replica=label)
+                batches.inc(replica=label)
+                self.latency.record(wall, examples=1)
+                self.dispatched += 1
+                with self.pool._lock:
+                    self.outstanding -= 1
+                    depth.set(self.outstanding, replica=label)
+                work.done.set()
+
+
+class ReplicaPool:
+    """Route micro-batches over one pinned executor per local device.
+
+    ``apply_fn``/``batch_size``/``runner_kwargs`` build a
+    :class:`BatchedRunner` per device (``data_parallel=False``,
+    ``device=`` pinned); pass ``make_runner(device) -> BatchedRunner``
+    instead for full control of each replica's construction (the
+    failure-injection tests do). ``devices`` defaults to every local
+    device; passing more replicas than devices round-robins devices
+    ("simulated replicas" — how the CPU harness exercises N-way routing
+    on one chip).
+    """
+
+    def __init__(self, apply_fn: "Callable | None" = None, *,
+                 batch_size: int = 64,
+                 devices: "list | None" = None,
+                 n_replicas: "int | None" = None,
+                 make_runner: "Callable[[Any], BatchedRunner] | None" = None,
+                 max_failures: int = 3,
+                 **runner_kwargs):
+        import jax
+
+        if (apply_fn is None) == (make_runner is None):
+            raise ValueError(
+                "pass exactly one of apply_fn or make_runner"
+            )
+        if max_failures < 1:
+            raise ValueError(f"max_failures must be >= 1, got {max_failures}")
+        if devices is None:
+            devices = list(jax.local_devices())
+        if n_replicas is None:
+            n_replicas = len(devices)
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if make_runner is None:
+            def make_runner(device):
+                return BatchedRunner(
+                    apply_fn, batch_size=batch_size, data_parallel=False,
+                    device=device, **runner_kwargs,
+                )
+        self.max_failures = max_failures
+        self._lock = threading.Lock()
+        self._closed = False
+        self._rr = 0  # round-robin tiebreak cursor
+        self.replicas = [
+            _Replica(i, devices[i % len(devices)],
+                     make_runner(devices[i % len(devices)]), self)
+            for i in range(n_replicas)
+        ]
+        self._worker_ids = {r.thread.ident: r for r in self.replicas}
+
+    # -- the BatchedRunner-compatible surface --------------------------------
+    @property
+    def chunk_size(self) -> int:
+        return self.replicas[0].runner.chunk_size
+
+    @property
+    def max_inflight_batches(self) -> int:
+        """Dispatches the micro-batcher should keep in flight: one per
+        healthy replica plus one assembling."""
+        return max(1, sum(not r.quarantined for r in self.replicas)) + 1
+
+    def run_batch_async(self, arrays: dict[str, np.ndarray]) -> _PoolFuture:
+        """Route one assembled micro-batch; returns a future resolving
+        to the same output ``BatchedRunner.run_batch`` produces."""
+        work = _Work(arrays)
+        self._route(work)
+        return _PoolFuture(work)
+
+    def run_batch(self, arrays: dict[str, np.ndarray]):
+        """Synchronous dispatch. Called FROM a replica worker thread (the
+        micro-batcher's per-row poison fallback resolving inside a
+        completion path) it executes inline on that replica instead of
+        re-queueing — a self-routed wait would deadlock the worker."""
+        me = self._worker_ids.get(threading.get_ident())
+        if me is not None:
+            return me.runner.run_batch(arrays)
+        return self.run_batch_async(arrays).result()
+
+    # -- routing -------------------------------------------------------------
+    def _route(self, work: _Work) -> None:
+        depth, _, _, _ = _metrics()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ReplicaPool is closed")
+            healthy = [r for r in self.replicas if not r.quarantined]
+            if not healthy:
+                raise AllReplicasQuarantinedError(
+                    f"all {len(self.replicas)} replicas quarantined "
+                    f"(>{self.max_failures} consecutive failures each); "
+                    "rebuild the pool"
+                )
+            # least outstanding work; round-robin among ties so idle
+            # replicas share the trickle load instead of replica 0
+            # absorbing it all
+            best = min(r.outstanding for r in healthy)
+            ties = [r for r in healthy if r.outstanding == best]
+            replica = ties[self._rr % len(ties)]
+            self._rr += 1
+            replica.outstanding += 1
+            depth.set(replica.outstanding, replica=str(replica.index))
+        replica.queue.put(work)
+
+    # -- failure accounting (called from worker threads) ---------------------
+    def _on_success(self, replica: _Replica) -> None:
+        replica.consecutive_failures = 0
+
+    def _on_failure(self, replica: _Replica) -> None:
+        replica.consecutive_failures += 1
+        if (replica.consecutive_failures >= self.max_failures
+                and not replica.quarantined):
+            with self._lock:
+                replica.quarantined = True
+            _metrics()[3].inc()
+            _log.error(
+                "replica %d (%s) quarantined after %d consecutive "
+                "failures; pool continues on %d healthy replica(s)",
+                replica.index, replica.device,
+                replica.consecutive_failures,
+                sum(not r.quarantined for r in self.replicas),
+            )
+            # re-route work it already accepted: those batches deserve a
+            # healthy executor, not a seat behind a broken one
+            requeued = 0
+            while True:
+                try:
+                    work = replica.queue.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if work is None:
+                    replica.queue.put(None)  # keep the shutdown token
+                    break
+                with self._lock:
+                    replica.outstanding -= 1
+                try:
+                    self._route(work)
+                    requeued += 1
+                except Exception as e:
+                    work.exc = e
+                    work.done.set()
+            if requeued:
+                _log.warning(
+                    "re-routed %d queued batch(es) off quarantined "
+                    "replica %d", requeued, replica.index,
+                )
+
+    # -- lifecycle / introspection -------------------------------------------
+    def close(self, *, drain: bool = True,
+              timeout_s: "float | None" = 30.0) -> None:
+        """Stop the pool. ``drain=True`` serves everything already
+        routed first; ``drain=False`` fails queued batches now."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for r in self.replicas:
+            if not drain:
+                while True:
+                    try:
+                        work = r.queue.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    if work is not None:
+                        work.exc = RuntimeError("ReplicaPool closed")
+                        work.done.set()
+            r.queue.put(None)  # wake + stop the worker after the drain
+        for r in self.replicas:
+            r.thread.join(timeout_s)
+            if r.thread.is_alive():  # pragma: no cover - watchdog only
+                _log.warning("replica %d did not stop in %ss",
+                             r.index, timeout_s)
+
+    def warmup(self, arrays: dict[str, np.ndarray]) -> None:
+        """Dispatch ``arrays`` to EVERY replica (compile its buckets)
+        before measurement/traffic — steady-state serving never pays a
+        first-request compile."""
+        # route one copy to each replica directly (bypass least-work:
+        # warmup must touch all of them)
+        futs = []
+        for r in self.replicas:
+            work = _Work(arrays)
+            with self._lock:
+                if self._closed:
+                    # a closed replica's worker has consumed its shutdown
+                    # token: queued work would hang forever
+                    raise RuntimeError("ReplicaPool is closed")
+                r.outstanding += 1
+                r.queue.put(work)
+            futs.append(_PoolFuture(work))
+        for f in futs:
+            f.result()
+
+    def snapshot(self) -> dict[str, Any]:
+        """Operator view: per-replica depth, in-flight, totals,
+        quarantine state, latency percentiles."""
+        with self._lock:
+            replicas = [
+                {
+                    "replica": r.index,
+                    "device": str(r.device),
+                    "depth": r.queue.qsize(),
+                    "in_flight": r.outstanding,
+                    "dispatched": r.dispatched,
+                    "consecutive_failures": r.consecutive_failures,
+                    "quarantined": r.quarantined,
+                    "latency_s": r.latency.step_time_percentiles((50, 95)),
+                }
+                for r in self.replicas
+            ]
+        return {
+            "replica_count": len(self.replicas),
+            "healthy_count": sum(
+                not r["quarantined"] for r in replicas),
+            "replicas": replicas,
+        }
+
+    def __enter__(self) -> "ReplicaPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=exc == (None, None, None))

@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.observability.tracing import span
+from sparkdl_tpu.runtime.completion import AsyncFetcher
 from sparkdl_tpu.runtime.dispatch import (
     ChainPolicy,
     chain_carry,
@@ -121,6 +122,13 @@ def finetune_classifier(
     measured step time vs the dispatch gap; 1 (default) = one dispatch
     per step, the exact pre-chaining behavior.
 
+    Host-metric reads are asynchronous (runtime/completion.py): each
+    dispatch's metric values start their device→host copy immediately
+    and are folded into ``history``/``metrics_cb`` one dispatch later,
+    behind the next dispatch — same values, same order, no blocking
+    device read on the hot path (checkpoint cadence stays at dispatch
+    boundaries, driven by a host-side step counter).
+
     With ``checkpoint_dir`` set, the full train state is async-saved every
     ``checkpoint_every`` steps plus once at the end, and an existing
     checkpoint in that directory is resumed from (already-trained steps are
@@ -171,11 +179,21 @@ def finetune_classifier(
                 resume_step = int(state.step)
             history: list[dict] = []
             last_saved = resume_step
+            #: host-tracked mirror of state.step — reading the device
+            #: scalar back per dispatch would cost a relay RTT on the
+            #: exact path the async pipeline is hiding
+            host_step = resume_step
+            # Async host-metric reads (runtime/completion.py): the D2H
+            # copy of each dispatch's metrics starts as soon as the
+            # dispatch lands and is COLLECTED one window later, behind
+            # the following dispatch — the history/metrics_cb trajectory
+            # stays per-step, in order, and numerically identical; only
+            # the host-side collection point moves.
+            fetcher = AsyncFetcher(window=2, path="train")
+            #: (ticket, wall_s, k, base_step, n_examples) awaiting emit
+            deferred: "list[tuple]" = []
 
             def emit(entries: "list[dict]") -> None:
-                # host-side cadence point: once per DISPATCH (= once per
-                # K steps when chaining), covering every step it fused
-                nonlocal last_saved
                 for m in entries:
                     _M_STEPS.inc()
                     _M_EXAMPLES.inc(m.pop("_examples"))
@@ -183,23 +201,46 @@ def finetune_classifier(
                     history.append(m)
                     if metrics_cb is not None:
                         metrics_cb(m)
-                if ckpt is not None:
-                    step_now = int(state.step)
-                    if ckpt.save(step_now, state):
-                        last_saved = step_now
-                    elif step_now - last_saved >= checkpoint_every:
-                        # chain boundaries (step = K, 2K, ...) may never
-                        # align with the manager's step-modulo policy:
-                        # force whenever a full interval has passed since
-                        # the last landed save, so chaining can thin the
-                        # cadence but never silently disable it
-                        if ckpt.save(step_now, state, force=True):
-                            last_saved = step_now
+
+            def collect(limit: int) -> None:
+                # resolve deferred metric reads down to ``limit`` in
+                # flight (submission order — the trajectory never
+                # reorders)
+                while len(deferred) > limit:
+                    ticket, wall, k, base, n_ex = deferred.pop(0)
+                    ms = ticket.result()
+                    emit([
+                        {
+                            **{key: float(np.asarray(v).reshape(-1)[j])
+                               for key, v in ms.items()},
+                            "step_time_s": wall / k,
+                            "step": base + j + 1,
+                            "_examples": n_ex,
+                        }
+                        for j in range(k)
+                    ])
+
+            def maybe_checkpoint() -> None:
+                # checkpoint cadence stays AT the dispatch boundary (the
+                # state is current here); only metric reads are deferred
+                nonlocal last_saved
+                if ckpt is None:
+                    return
+                if ckpt.save(host_step, state):
+                    last_saved = host_step
+                elif host_step - last_saved >= checkpoint_every:
+                    # chain boundaries (step = K, 2K, ...) may never
+                    # align with the manager's step-modulo policy:
+                    # force whenever a full interval has passed since
+                    # the last landed save, so chaining can thin the
+                    # cadence but never silently disable it
+                    if ckpt.save(host_step, state, force=True):
+                        last_saved = host_step
 
             def run_single(batch: dict) -> None:
-                nonlocal state
+                nonlocal state, host_step
                 n_examples = len(next(iter(batch.values())))
-                with span("train.step", step=int(state.step),
+                with span("train.step", step=host_step,
                           examples=n_examples):
                     staged = {
                         k: jax.device_put(jnp.asarray(v), data_sharding)
@@ -207,20 +248,27 @@ def finetune_classifier(
                     }
                     t0 = time.perf_counter()
                     state, metrics = step(state, staged)
-                    metrics = {k: float(v) for k, v in metrics.items()}
+                    # sync on the step scalar (not the metric values):
+                    # the wall stays an honest device time for the
+                    # ChainPolicy while the metric payload is still in
+                    # async flight
+                    jax.block_until_ready(state.step)
                     wall = time.perf_counter() - t0
                 record_dispatch("train", 1, wall)
                 policy.record(wall, 1)
-                metrics["step_time_s"] = wall
-                metrics["step"] = int(state.step)
-                metrics["_examples"] = n_examples
-                emit([metrics])
+                deferred.append(
+                    (fetcher.submit(metrics), wall, 1, host_step,
+                     n_examples)
+                )
+                host_step += 1
+                maybe_checkpoint()
+                collect(fetcher.window - 1)
 
             def run_chain(group: "list[dict]") -> None:
                 # K steps, ONE dispatch: stack on host, scan on device
                 # with the TrainState donated; per-step metrics come back
                 # stacked so the recorded trajectory stays exact.
-                nonlocal state
+                nonlocal state, host_step
                 k = len(group)
                 n_examples = len(next(iter(group[0].values())))
                 with span("dispatch.chain", path="train", k=k,
@@ -234,20 +282,16 @@ def finetune_classifier(
                     }
                     t0 = time.perf_counter()
                     state, ms = chained_step(state, xs)
-                    ms = {key: np.asarray(v) for key, v in ms.items()}
+                    jax.block_until_ready(state.step)
                     wall = time.perf_counter() - t0
                 record_dispatch("train", k, wall)
                 policy.record(wall, k)
-                base = int(state.step) - k
-                emit([
-                    {
-                        **{key: float(v[j]) for key, v in ms.items()},
-                        "step_time_s": wall / k,
-                        "step": base + j + 1,
-                        "_examples": n_examples,
-                    }
-                    for j in range(k)
-                ])
+                deferred.append(
+                    (fetcher.submit(ms), wall, k, host_step, n_examples)
+                )
+                host_step += k
+                maybe_checkpoint()
+                collect(fetcher.window - 1)
 
             pending: "list[dict]" = []
             pending_key = None
@@ -276,13 +320,14 @@ def finetune_classifier(
                     pending = []
             for b in pending:  # stream tail: no one-off-K compile
                 run_single(b)
+            collect(0)  # drain the async metric window: history complete
             if (
                 ckpt is not None
-                and int(state.step) > resume_step
-                and last_saved != int(state.step)
+                and host_step > resume_step
+                and last_saved != host_step
             ):
                 # final state always lands regardless of the interval policy
-                ckpt.save(int(state.step), state, force=True)
+                ckpt.save(host_step, state, force=True)
             return state.params, history
     finally:
         if ckpt is not None:

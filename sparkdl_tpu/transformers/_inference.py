@@ -10,8 +10,10 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
 import weakref
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Callable, Iterator
 
 import jax
@@ -22,6 +24,11 @@ from sparkdl_tpu.runtime.batching import (
     default_buckets,
     pad_to_bucket,
     rebatch,
+)
+from sparkdl_tpu.runtime.completion import (
+    AsyncFetcher,
+    FetchTicket,
+    start_fetch,
 )
 from sparkdl_tpu.runtime.dispatch import (
     ChainPolicy,
@@ -84,6 +91,23 @@ class BatchedRunner:
     #: ``chain_k=1`` (the chain buys nothing there anyway: big batches
     #: mean long programs, where the policy degrades to K=1 itself).
     chain_k: "int | None" = None
+    #: Async completion (runtime/completion.py): start each result's
+    #: device->host copy as soon as its dispatch lands and collect it
+    #: while the NEXT dispatch runs, instead of the blocking
+    #: ``np.asarray`` that serialized readback with dispatch. True
+    #: (default) pipelines :meth:`run` readback ``fetch_window`` deep;
+    #: False restores the strictly blocking readback (the parity
+    #: reference — outputs are bitwise identical either way).
+    async_fetch: bool = True
+    #: Results in flight for the async readback window. None = auto:
+    #: prefetch depth x resolved chain length (the same pipeline depth
+    #: the input side already runs at), so device memory holds at most
+    #: that many result buffers.
+    fetch_window: "int | None" = None
+    #: Pin every dispatch of this runner to ONE device (a ReplicaPool
+    #: executor). Implies no local data-parallel sharding — the pool
+    #: scales across devices by replication, not by splitting batches.
+    device: Any = None
 
     def __post_init__(self):
         self._chainer = ScanChainer(
@@ -100,6 +124,18 @@ class BatchedRunner:
         self._chunk = self.batch_size
         self._buckets = default_buckets(self.batch_size)
         self._sharding = None
+        if self.fetch_window is not None and self.fetch_window < 1:
+            raise ValueError(
+                f"fetch_window must be >= 1, got {self.fetch_window}"
+            )
+        if self.device is not None:
+            if self.data_parallel is True:
+                raise ValueError(
+                    "device= pins this runner to one chip; data_parallel "
+                    "scaling is the ReplicaPool's job (one runner per "
+                    "device), not this runner's"
+                )
+            return
         n_local = jax.local_device_count()
         if self.data_parallel is True and n_local == 1:
             raise ValueError(
@@ -149,6 +185,26 @@ class BatchedRunner:
         single-device hosts)."""
         return self._chunk
 
+    @property
+    def max_inflight_batches(self) -> int:
+        """How many ``run_batch_async`` dispatches a caller (the
+        micro-batcher) should keep in flight against this runner: one
+        resolving while one runs. A :class:`~sparkdl_tpu.serving.replicas.
+        ReplicaPool` overrides this with its healthy replica count."""
+        return 2 if self.async_fetch else 1
+
+    def _fetch_window(self) -> int:
+        """Async readback window: prefetch depth x resolved chain length
+        (a K-chain hands back K results per dispatch, so the window must
+        cover ``prefetch`` dispatches' worth of outputs to keep the
+        pipeline full). This holds up to that many RESULT buffers on the
+        device — workloads with outputs as large as their inputs should
+        pin ``fetch_window`` lower."""
+        if self.fetch_window is not None:
+            return self.fetch_window
+        chain = self._chainer.chain_k or self._chainer.policy.max_chain
+        return max(2, self.prefetch) * max(1, chain)
+
     def run(self, rows: Iterator[dict[str, np.ndarray]]) -> Iterator[np.ndarray]:
         """Yield one output per input row, in order.
 
@@ -174,7 +230,17 @@ class BatchedRunner:
         # ``batch.device_step`` span would only time the host-side
         # conversion of an already-materialized output here, so it is
         # gone rather than left lying about where the time went.
-        for i, out in enumerate(self._chainer.map_stream(results)):
+        outputs = self._chainer.map_stream(results)
+        if self.async_fetch:
+            # Async completion: each output's D2H copy starts the moment
+            # its dispatch lands and is collected while the following
+            # dispatches run — readback hides behind compute instead of
+            # serializing with it. Bitwise-identical to the blocking
+            # path; a device error still surfaces on ITS batch.
+            outputs = AsyncFetcher(
+                window=self._fetch_window(), path="batch"
+            ).stream(outputs)
+        for i, out in enumerate(outputs):
             n = metas[i]
             if isinstance(out, (tuple, list)):
                 arrays: Any = [np.asarray(o) for o in out]
@@ -248,6 +314,16 @@ class BatchedRunner:
         it — so the outputs keep their real dtypes and feature shapes,
         just with 0 rows.
         """
+        return self.run_batch_async(arrays).result()
+
+    def run_batch_async(self, arrays: dict[str, np.ndarray]) -> "BatchResult":
+        """The future-returning :meth:`run_batch`: dispatch now, start
+        the async D2H copy, and hand back a :class:`BatchResult` whose
+        ``result()`` blocks only for whatever copy time is left. The
+        micro-batcher pipelines on this — it assembles and dispatches
+        the NEXT micro-batch while the previous one's readback lands.
+        Dispatch/occupancy semantics are identical to :meth:`run_batch`
+        (one request group = one dispatch, never chained)."""
         padded = pad_to_bucket(arrays, self._buckets)
         t0 = time.perf_counter()
         with span("serving.device_step", rows=padded.n_valid,
@@ -256,21 +332,75 @@ class BatchedRunner:
             # would couple unrelated requests' failure domains, and the
             # micro-batcher already amortizes dispatch across riders
             out = self._jitted(self._transfer(padded.arrays))
-            if isinstance(out, (tuple, list)):
-                result: Any = tuple(
-                    np.asarray(o)[: padded.n_valid] for o in out
-                )
-            else:
-                result = np.asarray(out)[: padded.n_valid]
-        record_dispatch("serving", 1, time.perf_counter() - t0)
-        return result
+            ticket = start_fetch(out, path="serving")
+        return BatchResult(ticket, padded.n_valid, t0)
 
     def _transfer(self, arrays: dict[str, np.ndarray]):
         if self._sharding is not None:
             # committed sharded inputs: one shard per local chip, and jit
             # compiles the apply SPMD over the dp mesh from the sharding
             return jax.device_put(arrays, self._sharding)
+        if self.device is not None:
+            # replica executor: committed to its device, so jit compiles
+            # and runs there — N pinned runners = N independent chips
+            return jax.device_put(arrays, self.device)
         return jax.device_put(arrays)
+
+
+class BatchResult:
+    """In-flight :meth:`BatchedRunner.run_batch_async` result.
+
+    ``result()`` collects the host output (unpadded to the live rows),
+    records the dispatch into the spine exactly once, and re-raises
+    this batch's device error if its program failed. Thread-safe and
+    idempotent, so the micro-batcher may resolve from any thread; a
+    fallback-pool timeout is not terminal (the result stays
+    collectable).
+
+    Metric semantics: the recorded ``sparkdl_dispatch_seconds`` wall
+    spans dispatch to COLLECTION — when resolution is pipelined (the
+    micro-batcher keeps ``max_inflight`` batches open) it includes the
+    bounded residency behind the predecessors, so the serving wall
+    histogram reads as pipeline latency, not pure device time (the
+    count stays exact; overhead_share only gets more conservative).
+    The synchronous :meth:`BatchedRunner.run_batch` resolves
+    immediately and keeps the old pure-dispatch wall."""
+
+    __slots__ = ("_ticket", "_n_valid", "_t0", "_done", "_value", "_exc",
+                 "_lock")
+
+    def __init__(self, ticket: FetchTicket, n_valid: int, t0: float):
+        self._ticket = ticket
+        self._n_valid = n_valid
+        self._t0 = t0
+        self._done = False
+        self._value: Any = None
+        self._exc: "BaseException | None" = None
+        self._lock = threading.Lock()
+
+    def result(self, timeout: "float | None" = None):
+        with self._lock:
+            if not self._done:
+                try:
+                    out = self._ticket.result(timeout)
+                except FuturesTimeoutError:
+                    raise  # not terminal: collect again later
+                except BaseException as e:
+                    self._exc = e
+                else:
+                    if isinstance(out, (tuple, list)):
+                        self._value = tuple(
+                            np.asarray(o)[: self._n_valid] for o in out
+                        )
+                    else:
+                        self._value = np.asarray(out)[: self._n_valid]
+                self._done = True
+                record_dispatch(
+                    "serving", 1, time.perf_counter() - self._t0
+                )
+            if self._exc is not None:
+                raise self._exc
+            return self._value
 
 
 #: graph object -> {cache key: BatchedRunner}; weak so graphs can be GC'd.

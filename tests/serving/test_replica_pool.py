@@ -1,0 +1,264 @@
+"""ReplicaPool: routing, parity, drain, quarantine failover.
+
+Runs on the conftest 8-virtual-device CPU mesh, so multi-replica pools
+get real distinct devices. Output parity is the load-bearing contract:
+a pool routes WHOLE micro-batches, so every result must be bitwise
+identical to the single-device engine's.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.serving import ServingEngine
+from sparkdl_tpu.serving.replicas import (
+    AllReplicasQuarantinedError,
+    ReplicaPool,
+)
+from sparkdl_tpu.transformers._inference import BatchedRunner
+
+DIM = 6
+_W = jnp.asarray(
+    np.random.default_rng(3).standard_normal((DIM, DIM)), jnp.float32
+)
+
+
+def _apply(b):
+    return jnp.tanh(b["x"] @ _W)
+
+
+def _batch(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"x": rng.standard_normal((n, DIM)).astype(np.float32)}
+
+
+class _FlakyRunner:
+    """Runner wrapper that fails the first ``n_failures`` dispatches."""
+
+    def __init__(self, inner, n_failures):
+        self._inner = inner
+        self._left = n_failures
+        self.chunk_size = inner.chunk_size
+
+    def run_batch(self, arrays):
+        if self._left > 0:
+            self._left -= 1
+            raise RuntimeError("injected executor failure")
+        return self._inner.run_batch(arrays)
+
+
+def test_pool_output_bitwise_matches_single_device():
+    single = BatchedRunner(_apply, batch_size=8, data_parallel=False)
+    with ReplicaPool(_apply, batch_size=8, n_replicas=3) as pool:
+        for seed in range(6):
+            b = _batch(5, seed)
+            np.testing.assert_array_equal(
+                pool.run_batch(b), single.run_batch(b)
+            )
+
+
+def test_routing_spreads_load_over_replicas():
+    with ReplicaPool(_apply, batch_size=8, n_replicas=2) as pool:
+        pool.warmup(_batch(8))
+        futs = [pool.run_batch_async(_batch(8, seed=i)) for i in range(24)]
+        for f in futs:
+            f.result()
+        snap = pool.snapshot()
+    dispatched = [r["dispatched"] for r in snap["replicas"]]
+    # warmup = 1 each; the burst must land on BOTH replicas
+    assert all(d > 1 for d in dispatched), dispatched
+    assert snap["replica_count"] == 2 and snap["healthy_count"] == 2
+
+
+def test_least_outstanding_routing():
+    with ReplicaPool(_apply, batch_size=8, n_replicas=4) as pool:
+        futs = [pool.run_batch_async(_batch(4, seed=i)) for i in range(8)]
+        for f in futs:
+            f.result()
+        snap = pool.snapshot()
+    # 8 batches over 4 replicas, routed least-outstanding with
+    # round-robin tie-break: nobody gets flooded while a peer idles
+    dispatched = [r["dispatched"] for r in snap["replicas"]]
+    assert sum(dispatched) == 8
+    assert all(d >= 1 for d in dispatched), dispatched
+
+
+def test_drain_serves_all_then_zero_depth():
+    single = BatchedRunner(_apply, batch_size=8, data_parallel=False)
+    pool = ReplicaPool(_apply, batch_size=8, n_replicas=2)
+    futs = [pool.run_batch_async(_batch(3, seed=i)) for i in range(12)]
+    pool.close(drain=True)
+    for i, f in enumerate(futs):
+        # close(drain=True) returned only after every routed batch was
+        # served: results are immediately available, and exact
+        np.testing.assert_array_equal(
+            f.result(timeout=0), single.run_batch(_batch(3, seed=i))
+        )
+    snap = pool.snapshot()
+    assert all(r["depth"] == 0 and r["in_flight"] == 0
+               for r in snap["replicas"])
+    with pytest.raises(RuntimeError, match="closed"):
+        pool.run_batch_async(_batch(2))
+
+
+def test_close_without_drain_fails_queued():
+    pool = ReplicaPool(_apply, batch_size=8, n_replicas=1)
+    # stall the single worker behind a slow runner? simpler: close with
+    # work queued by submitting from a stalled state is racy — just
+    # verify closed-pool admission fails fast
+    pool.close(drain=False)
+    with pytest.raises(RuntimeError, match="closed"):
+        pool.run_batch_async(_batch(2))
+
+
+def test_quarantine_after_repeated_failures_pool_survives():
+    devices = jax.local_devices()
+    flaky_device = devices[0]
+
+    def make_runner(device):
+        inner = BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                              device=device)
+        if device is flaky_device:
+            return _FlakyRunner(inner, n_failures=10)
+        return inner
+
+    pool = ReplicaPool(make_runner=make_runner, max_failures=2,
+                       devices=devices[:2], n_replicas=2)
+    try:
+        failures = 0
+        results = []
+        for i in range(16):
+            try:
+                results.append((i, pool.run_batch(_batch(4, seed=i))))
+            except RuntimeError as e:
+                assert "injected executor failure" in str(e)
+                failures += 1
+        snap = pool.snapshot()
+        # replica 0 fails its first dispatches -> quarantined after 2;
+        # everything after routes to replica 1 and succeeds
+        assert snap["healthy_count"] == 1
+        assert snap["replicas"][0]["quarantined"] is True
+        assert failures == 2, failures
+        assert len(results) == 14
+        single = BatchedRunner(_apply, batch_size=8, data_parallel=False)
+        for i, out in results:
+            np.testing.assert_array_equal(
+                out, single.run_batch(_batch(4, seed=i))
+            )
+    finally:
+        pool.close()
+
+
+def test_all_replicas_quarantined_raises():
+    def make_runner(device):
+        return _FlakyRunner(
+            BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                          device=device),
+            n_failures=1000,
+        )
+
+    pool = ReplicaPool(make_runner=make_runner, max_failures=1,
+                       n_replicas=2)
+    try:
+        for i in range(2):
+            with pytest.raises(RuntimeError,
+                               match="injected executor failure"):
+                pool.run_batch(_batch(2, seed=i))
+        with pytest.raises(AllReplicasQuarantinedError):
+            pool.run_batch(_batch(2))
+    finally:
+        pool.close()
+
+
+def test_serving_engine_over_pool_end_to_end():
+    with ReplicaPool(_apply, batch_size=8, n_replicas=2) as pool:
+        pool.warmup(_batch(8))
+        with ServingEngine(pool, max_wait_s=0.002) as eng:
+            futs = [eng.submit({"x": np.full((DIM,), float(i), np.float32)})
+                    for i in range(48)]
+            for i, f in enumerate(futs):
+                np.testing.assert_allclose(
+                    f.result(timeout=30),
+                    np.tanh(np.full((DIM,), float(i)) @ np.asarray(_W)),
+                    rtol=1e-6,
+                )
+            snap = eng.snapshot()
+        # snapshot carries the per-replica fields (ISSUE 4 satellite)
+        assert snap["replica_count"] == 2
+        assert {"depth", "in_flight", "quarantined"} <= set(
+            snap["replicas"][0])
+        assert snap["completed"] == 48
+
+
+def test_engine_poison_row_retry_routes_through_pool():
+    # an apply that fails when any row is NaN: the batch fails, the
+    # per-row fallback must isolate the culprit through the pool path
+    def apply_checked(b):
+        return jnp.tanh(b["x"] @ _W)
+
+    calls = []
+
+    class _PoisonRunner:
+        def __init__(self, inner):
+            self._inner = inner
+            self.chunk_size = inner.chunk_size
+
+        def run_batch(self, arrays):
+            calls.append(len(arrays["x"]))
+            if np.isnan(arrays["x"]).any():
+                raise RuntimeError("poison batch")
+            return self._inner.run_batch(arrays)
+
+    def make_runner(device):
+        return _PoisonRunner(
+            BatchedRunner(apply_checked, batch_size=8,
+                          data_parallel=False, device=device)
+        )
+
+    pool = ReplicaPool(make_runner=make_runner, n_replicas=2)
+    try:
+        with ServingEngine(pool, max_wait_s=0.05) as eng:
+            good = [eng.submit({"x": np.full((DIM,), 1.0, np.float32)})
+                    for _ in range(3)]
+            bad = eng.submit(
+                {"x": np.full((DIM,), np.nan, np.float32)})
+            # hold the window open so they coalesce
+            for f in good:
+                assert f.result(timeout=30) is not None
+            with pytest.raises(RuntimeError, match="poison batch"):
+                bad.result(timeout=30)
+    finally:
+        pool.close()
+
+
+@pytest.mark.slow
+def test_replica_pool_soak():
+    """Sustained mixed load over a 2-replica pool: every request served,
+    values exact, pool drains clean."""
+    single = BatchedRunner(_apply, batch_size=16, data_parallel=False)
+    rng = np.random.default_rng(11)
+    with ReplicaPool(_apply, batch_size=16, n_replicas=2) as pool:
+        pool.warmup(_batch(16))
+        with ServingEngine(pool, max_queue_depth=4096,
+                           max_wait_s=0.001) as eng:
+            rows = [rng.standard_normal(DIM).astype(np.float32)
+                    for _ in range(600)]
+            futs = []
+            for i, r in enumerate(rows):
+                futs.append(eng.submit({"x": r}))
+                if i % 50 == 49:
+                    time.sleep(0.005)  # bursty arrival pattern
+            expect = list(single.run({"x": r} for r in rows))
+            for i, f in enumerate(futs):
+                np.testing.assert_array_equal(f.result(timeout=60),
+                                              expect[i])
+            snap = eng.snapshot()
+        assert snap["completed"] == 600 and snap["failed"] == 0
+        assert all(r["depth"] == 0 for r in snap["replicas"])
+        dispatched = [r["dispatched"] for r in snap["replicas"]]
+        assert all(d > 0 for d in dispatched), dispatched
