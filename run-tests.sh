@@ -152,6 +152,121 @@ assert "sparkdl_fetch_wait_seconds" in obs, sorted(obs)
 print("bench_serving contract OK (snapshot embedded)")
 '
 
+# Fault-injection smoke (ISSUE 5): resumable_finetune survives an
+# injected crash at step k and its per-step loss trajectory matches the
+# uninterrupted run BITWISE; the disarmed fault_point must stay
+# invisible next to a device dispatch (bench-guarded: per-call cost and
+# its share of one measured BatchedRunner.run dispatch).
+JAX_PLATFORMS=cpu python -c '
+import tempfile, time
+import numpy as np, jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.reliability import RetryPolicy, resumable_finetune
+from sparkdl_tpu.reliability.faults import fault_point, inject
+from sparkdl_tpu.train.finetune import batches_from_arrays, finetune_classifier
+from sparkdl_tpu.transformers._inference import BatchedRunner
+
+rng = np.random.default_rng(0)
+params = {"w": jnp.asarray(rng.standard_normal((8, 3)) * 0.1, jnp.float32)}
+data = {"x": rng.standard_normal((64, 8)).astype(np.float32),
+        "labels": rng.integers(0, 3, 64).astype(np.int32)}
+mk = lambda: batches_from_arrays(data, batch_size=16, epochs=2, seed=3)
+_, base = finetune_classifier(lambda p, x: x @ p["w"], params, mk(),
+                              learning_rate=0.1)
+with tempfile.TemporaryDirectory() as d, inject("dispatch:RuntimeError@5"):
+    _, got = resumable_finetune(
+        lambda p, x: x @ p["w"], params, mk, checkpoint_dir=d,
+        checkpoint_every=2, learning_rate=0.1,
+        retry=RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                          sleep=lambda s: None))
+assert [(h["step"], h["loss"], h["accuracy"]) for h in got] == \
+    [(h["step"], h["loss"], h["accuracy"]) for h in base]  # bitwise
+print("fault-injection smoke OK: crash@5 recovered, trajectory bitwise")
+
+# disarmed overhead guard: per-call cost ~a global load + None test
+n = 200_000
+t0 = time.perf_counter()
+for _ in range(n):
+    fault_point("dispatch")
+per_call = (time.perf_counter() - t0) / n
+assert per_call < 2e-6, f"disarmed fault_point {per_call*1e9:.0f}ns/call"
+w = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+r = BatchedRunner(lambda b: jnp.tanh(b["x"] @ w), batch_size=8,
+                  data_parallel=False)
+rows = [{"x": rng.standard_normal(8).astype(np.float32)}
+        for _ in range(64)]
+list(r.run(iter(rows)))  # warm the jit cache
+t0 = time.perf_counter()
+list(r.run(iter({"x": row["x"]} for row in rows)))
+per_dispatch = (time.perf_counter() - t0) / 8
+assert per_call / per_dispatch < 0.01, (per_call, per_dispatch)
+print(f"fault_point overhead OK: {per_call*1e9:.0f}ns/call disarmed, "
+      f"{100*per_call/per_dispatch:.3f}% of one BatchedRunner dispatch")
+'
+# Quarantine-reintegration smoke (ISSUE 5): a BENCH_REPLICAS=2 pool
+# loses one executor mid-load — its riders are re-routed (zero errors),
+# the replica is quarantined, and after the executor "restarts" a
+# probation probe reintegrates it.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+  BENCH_REPLICAS=2 python -c '
+import os, threading, time
+import numpy as np, jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.observability import registry
+from sparkdl_tpu.serving import ReplicaPool, ServingEngine
+from sparkdl_tpu.transformers._inference import BatchedRunner
+
+n_replicas = int(os.environ["BENCH_REPLICAS"])
+w = jnp.asarray(np.random.default_rng(0).standard_normal((8, 8)),
+                jnp.float32)
+down = threading.Event()
+
+class Killable:
+    def __init__(self, inner, killable):
+        self._inner, self._killable = inner, killable
+        self.chunk_size = inner.chunk_size
+    def run_batch(self, arrays):
+        if self._killable and down.is_set():
+            raise RuntimeError("executor down")
+        return self._inner.run_batch(arrays)
+
+made = []
+def make_runner(device):
+    r = Killable(BatchedRunner(lambda b: jnp.tanh(b["x"] @ w),
+                               batch_size=8, data_parallel=False,
+                               device=device), killable=not made)
+    made.append(r)
+    return r
+
+pool = ReplicaPool(make_runner=make_runner, n_replicas=n_replicas,
+                   max_failures=2, probation_s=0.05, probation_max_s=1.0)
+pool.warmup({"x": np.zeros((8, 8), np.float32)})
+with ServingEngine(pool, max_wait_s=0.002) as eng:
+    down.set()  # kill replica 0 mid-load
+    futs = [eng.submit({"x": np.full((8,), float(i), np.float32)})
+            for i in range(48)]
+    for i, f in enumerate(futs):  # every rider re-routed, zero errors
+        np.testing.assert_allclose(
+            f.result(timeout=60),
+            np.tanh(np.full((8,), float(i), np.float32) @ np.asarray(w)),
+            rtol=1e-5)
+    assert pool.snapshot()["healthy_count"] == n_replicas - 1
+    down.clear()  # "restart" the executor; probation probes rejoin it
+    deadline = time.monotonic() + 20.0
+    while (pool.snapshot()["healthy_count"] < n_replicas
+           and time.monotonic() < deadline):
+        eng.submit({"x": np.zeros((8,), np.float32)}).result(timeout=60)
+        time.sleep(0.02)
+    snap = pool.snapshot()
+pool.close()
+assert snap["healthy_count"] == n_replicas, snap
+reint = registry().get("sparkdl_replica_reintegrated_total")
+assert reint is not None and reint.snapshot_values().get("", 0) >= 1
+print(f"quarantine-reintegration smoke OK: {n_replicas}-replica pool "
+      "lost one executor, riders re-routed, replica rejoined via "
+      "probation probe")
+'
+
 # Metrics-endpoint smoke (ISSUE 2): start the exporter the way production
 # does (SPARKDL_TPU_METRICS_PORT -> maybe_start_metrics_server), scrape
 # once, assert well-formed Prometheus exposition text.

@@ -11,14 +11,18 @@ all-or-nothing, restart resumes from checkpoint).
 """
 
 from sparkdl_tpu.checkpoint.manager import (
+    CheckpointCorruptError,
     CheckpointManager,
+    checkpoint_digest,
     latest_step,
     restore_matching,
     save_and_wait,
 )
 
 __all__ = [
+    "CheckpointCorruptError",
     "CheckpointManager",
+    "checkpoint_digest",
     "latest_step",
     "restore_matching",
     "save_and_wait",

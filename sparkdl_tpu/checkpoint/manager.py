@@ -13,10 +13,26 @@ conventions on top:
   with each leaf materialized on the template leaf's sharding — resume
   drops straight back into the same mesh;
 * ``keep`` bounds disk usage (old steps GC'd).
+
+Integrity (reliability layer): rename atomicity protects against a crash
+*during* a save, but not against after-the-fact corruption — a truncated
+file on a recycled disk, a bad copy, a bit flip — which previously
+poisoned every future restore of that directory. Each landed save now
+gets a content digest recorded in a sidecar manifest
+(``sparkdl_integrity.json``); :meth:`CheckpointManager.restore` verifies
+the chosen step against it and **falls back to the newest intact step**
+when the newest one is torn (``sparkdl_checkpoint_corrupt_total`` /
+``sparkdl_checkpoint_fallbacks_total`` count it). The synchronous
+queueing part of :meth:`~CheckpointManager.save` additionally runs under
+a small :class:`~sparkdl_tpu.reliability.retry.RetryPolicy` so a
+transient filesystem error does not kill a training run.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
 import time
 from typing import Any
@@ -25,6 +41,10 @@ import jax
 
 from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.observability.tracing import record_span, span
+from sparkdl_tpu.reliability.faults import fault_point
+from sparkdl_tpu.reliability.retry import RetryPolicy
+
+_log = logging.getLogger(__name__)
 
 _M_SAVES = registry().counter(
     "sparkdl_checkpoint_saves_total", "checkpoint saves queued")
@@ -38,6 +58,48 @@ _M_RESTORE_TIME = registry().histogram(
 _M_WAIT_TIME = registry().histogram(
     "sparkdl_checkpoint_wait_seconds",
     "time blocked draining queued async saves")
+_M_CORRUPT = registry().counter(
+    "sparkdl_checkpoint_corrupt_total",
+    "checkpoints that failed integrity verification")
+_M_FALLBACKS = registry().counter(
+    "sparkdl_checkpoint_fallbacks_total",
+    "restores that fell back past a corrupt newest step")
+
+#: Sidecar manifest (NOT inside any step dir, so Orbax GC never eats it).
+MANIFEST_NAME = "sparkdl_integrity.json"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The requested checkpoint failed integrity verification (and, for
+    latest-step restores, so did every older candidate)."""
+
+
+def checkpoint_digest(step_dir: str) -> dict:
+    """Content digest of one landed step directory.
+
+    sha256 over (sorted relative path, file bytes) pairs — any
+    truncation, missing file, or flipped byte changes it. Sizes/count
+    ride along for cheap debugging of a mismatch.
+    """
+    h = hashlib.sha256()
+    n_files = 0
+    n_bytes = 0
+    for root, dirs, files in os.walk(step_dir):
+        dirs.sort()  # in-place: pins the walk's traversal order
+        for name in sorted(files):
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, step_dir)
+            h.update(rel.encode())
+            h.update(b"\0")
+            with open(path, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    h.update(chunk)
+            n_files += 1
+            n_bytes += os.path.getsize(path)
+    return {"sha256": h.hexdigest(), "files": n_files, "bytes": n_bytes}
 
 
 def _abstract_like(tree: Any):
@@ -56,16 +118,29 @@ class CheckpointManager:
 
     >>> ckpt = CheckpointManager(dir, keep=3)
     >>> ckpt.save(step, state)            # async; returns immediately
-    >>> state = ckpt.restore(template=state)   # latest step, same shardings
+    >>> state = ckpt.restore(template=state)   # newest INTACT step
     >>> ckpt.close()
     """
 
     def __init__(self, directory: str | os.PathLike, *, keep: int = 3,
-                 save_interval_steps: int = 1):
+                 save_interval_steps: int = 1,
+                 verify_integrity: bool = True,
+                 retry: "RetryPolicy | None" = None):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.directory = os.fspath(directory)
+        self.verify_integrity = verify_integrity
+        # the sync (queueing) half of save is cheap and idempotent until
+        # it succeeds: transient FS errors deserve a second chance, fast
+        self._retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, base_delay_s=0.02, max_delay_s=0.5,
+            retryable=(OSError, RuntimeError),
+        )
+        #: steps whose async save has been queued but whose digest is not
+        #: yet recorded (digests hash what is ON DISK, so they finalize
+        #: at the next wait()/restore()/close() barrier)
+        self._pending_digest: "set[int]" = set()
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -87,21 +162,139 @@ class CheckpointManager:
         # checkpoint.save stage percentiles (monotonic clock: record_span
         # and Request timestamps share time.monotonic)
         t0 = time.monotonic()
-        saved = self._mgr.save(
-            int(step), args=self._ocp.args.StandardSave(state), force=force
-        )
+
+        def queue_save():
+            fault_point("checkpoint.save")
+            return self._mgr.save(
+                int(step), args=self._ocp.args.StandardSave(state),
+                force=force,
+            )
+
+        saved = self._retry.call(queue_save, site="checkpoint.save")
         if saved:
             _M_SAVES.inc()
             _M_SAVE_TIME.observe(time.monotonic() - t0)
             record_span("checkpoint.save", t0, time.monotonic(),
                         step=int(step))
+            if self.verify_integrity:
+                self._pending_digest.add(int(step))
         return saved
 
     def wait(self) -> None:
         """Block until every queued async save has landed on disk."""
         t0 = time.perf_counter()
         self._mgr.wait_until_finished()
+        self._finalize_digests()
         _M_WAIT_TIME.observe(time.perf_counter() - t0)
+
+    # -- integrity -----------------------------------------------------------
+    def _manifest_path(self) -> str:
+        return os.path.join(self.directory, MANIFEST_NAME)
+
+    def _load_manifest(self) -> dict:
+        try:
+            with open(self._manifest_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def _write_manifest(self, manifest: dict) -> None:
+        # same atomicity discipline as the checkpoints themselves
+        tmp = self._manifest_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, sort_keys=True)
+        os.replace(tmp, self._manifest_path())
+
+    def _step_dir(self, step: int) -> "str | None":
+        path = os.path.join(self.directory, str(step))
+        return path if os.path.isdir(path) else None
+
+    def _finalize_digests(self) -> "dict[int, dict]":
+        """Record digests for landed saves and prune GC'd steps. Called
+        at the wait()/restore()/close() barriers — the points where the
+        async writes are known to be complete on disk.
+
+        Returns the digests computed by THIS call so a restore that just
+        finalized a step can verify it without hashing the (possibly
+        multi-GB) step dir a second time."""
+        fresh: "dict[int, dict]" = {}
+        if not self.verify_integrity:
+            return fresh
+        live = set(self._mgr.all_steps())
+        manifest = self._load_manifest()
+        changed = False
+        for step in sorted(self._pending_digest):
+            d = self._step_dir(step)
+            if step in live and d is not None:
+                digest = checkpoint_digest(d)
+                manifest[str(step)] = digest
+                fresh[step] = digest
+                changed = True
+        self._pending_digest.clear()
+        stale = [k for k in manifest if int(k) not in live]
+        for k in stale:
+            del manifest[k]
+            changed = True
+        if changed:
+            self._write_manifest(manifest)
+        return fresh
+
+    def _quarantine_step(self, step: int) -> None:
+        """Rename a corrupt step dir out of the step namespace.
+
+        The bytes stay on disk for forensics, but the step number is
+        freed: a resumed run re-reaching it can save cleanly instead of
+        hitting orbax's step-already-exists refusal against the torn
+        dir. Best effort — a rename failure only logs (the restore
+        fallback already succeeded or is about to raise anyway).
+        """
+        d = self._step_dir(step)
+        if d is None:
+            return
+        for n in range(100):
+            suffix = f"-{n}" if n else ""
+            dest = os.path.join(
+                self.directory, f"corrupt-step-{int(step)}{suffix}")
+            if os.path.exists(dest):
+                continue
+            try:
+                os.rename(d, dest)
+            except OSError as e:  # pragma: no cover - fs-dependent
+                _log.warning(
+                    "could not quarantine corrupt checkpoint step %s "
+                    "(%r); a resumed run may fail to re-save it",
+                    step, e,
+                )
+                return
+            # orbax caches step metadata in-process: reload so save()
+            # stops believing the quarantined step still exists
+            self._mgr.reload()
+            self._finalize_digests()  # prune the manifest entry
+            _log.warning(
+                "quarantined corrupt checkpoint step %s -> %s",
+                step, dest,
+            )
+            return
+
+    def verify(self, step: int, *,
+               _actual: "dict | None" = None) -> "bool | None":
+        """Integrity check of one landed step against the manifest.
+
+        True = digest matches; False = corrupt (mismatch or missing
+        files); None = no recorded digest (pre-integrity checkpoint or
+        foreign writer) — the caller decides whether to trust it.
+        ``_actual`` lets restore() pass the digest its own finalize
+        barrier just computed instead of re-hashing the step dir.
+        """
+        recorded = self._load_manifest().get(str(int(step)))
+        if recorded is None:
+            return None
+        if _actual is None:
+            d = self._step_dir(int(step))
+            if d is None:
+                return False
+            _actual = checkpoint_digest(d)
+        return _actual["sha256"] == recorded["sha256"]
 
     # -- restore -------------------------------------------------------------
     def latest_step(self) -> int | None:
@@ -111,18 +304,112 @@ class CheckpointManager:
         return sorted(self._mgr.all_steps())
 
     def restore(self, step: int | None = None, *, template: Any) -> Any:
-        """Restore ``step`` (default: latest) shaped/sharded like ``template``.
+        """Restore ``step`` (default: newest INTACT) shaped/sharded like
+        ``template``.
 
         Each ``jax.Array`` leaf of the template contributes its sharding, so
         the restored state lands distributed across the same mesh it was
         initialized for — no host-memory spike, no manual device_put.
+
+        A latest-step restore verifies the candidate against the
+        integrity manifest and falls back to the newest step that IS
+        intact when the newest write was torn — one corrupt file no
+        longer poisons every future resume. Each corrupt step is also
+        *quarantined* (its dir renamed out of the step namespace): a
+        resumed run will re-reach that step number and re-save it, which
+        orbax refuses while the torn dir squats on the name. A step with
+        no recorded digest that fails to restore is only quarantined
+        after an older step restores successfully — until the template
+        is proven good, the failure could be the caller's (wrong
+        shape/sharding), and renaming intact history would be
+        destructive. An
+        explicitly requested ``step`` never falls back and is never
+        quarantined: corruption there raises
+        :class:`CheckpointCorruptError`. ``verify_integrity=False``
+        keeps the pre-integrity behavior exactly: one restore of the
+        chosen step, any error propagating as itself.
         """
-        if step is None:
-            step = self._mgr.latest_step()
-        if step is None:
+        # saves still in flight must land before they can be verified
+        # (and before orbax can read them back)
+        if self._pending_digest:
+            self._mgr.wait_until_finished()
+        fresh = self._finalize_digests()
+        if step is not None:
+            candidates = [int(step)]
+            pinned = True
+        else:
+            candidates = sorted(self._mgr.all_steps(), reverse=True)
+            pinned = False
+        if not candidates:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}"
             )
+        if not self.verify_integrity:
+            return self._do_restore(candidates[0], template)
+        errors: "list[str]" = []
+        #: steps that failed to restore with NO digest verdict: whether
+        #: that is corruption or a bad template only becomes clear when
+        #: an older candidate restores (or none does) — see below
+        suspects: "list[int]" = []
+        for i, s in enumerate(candidates):
+            ok = self.verify(s, _actual=fresh.get(int(s)))
+            if ok is False:
+                _M_CORRUPT.inc()
+                msg = f"step {s}: integrity digest mismatch (torn write?)"
+                _log.error("checkpoint %s under %s", msg, self.directory)
+                if pinned:
+                    raise CheckpointCorruptError(
+                        f"requested checkpoint {msg} under {self.directory}"
+                    )
+                errors.append(msg)
+                self._quarantine_step(s)
+                continue
+            try:
+                out = self._do_restore(s, template)
+            except Exception as e:
+                if ok is True:
+                    # the step verified INTACT on disk, so this failure
+                    # is not corruption (template shape/sharding
+                    # mismatch, transient device error) — falling back
+                    # would silently resume from the wrong step
+                    raise
+                # unreadable with no digest verdict (pre-manifest
+                # checkpoint, or corruption below the digest's radar —
+                # including a deleted file's FileNotFoundError): same
+                # fallback path. Quarantine is DEFERRED until an older
+                # candidate restores: if the failure was really a bad
+                # template (wrong shape/sharding), every candidate fails
+                # identically, and renaming them all would destroy an
+                # intact pre-manifest history over one caller mistake.
+                _log.error(
+                    "checkpoint step %s under %s failed to restore: %r",
+                    s, self.directory, e,
+                )
+                if pinned:
+                    raise
+                errors.append(f"step {s}: restore failed: {e!r}")
+                suspects.append(int(s))
+                continue
+            # this restore proves the template matches the on-disk
+            # lineage — the newer no-verdict failures really were
+            # unreadable, so counting and quarantining them is safe now
+            for sus in suspects:
+                _M_CORRUPT.inc()
+                self._quarantine_step(sus)
+            if i > 0:
+                _M_FALLBACKS.inc()
+                _log.warning(
+                    "restored fallback step %s under %s (newer "
+                    "candidate(s) corrupt: %s)",
+                    s, self.directory, "; ".join(errors),
+                )
+            return out
+        raise CheckpointCorruptError(
+            f"no intact checkpoint under {self.directory}: "
+            + "; ".join(errors)
+        )
+
+    def _do_restore(self, step: int, template: Any) -> Any:
         t0 = time.perf_counter()
         with span("checkpoint.restore", step=int(step)):
             out = self._mgr.restore(
@@ -135,6 +422,7 @@ class CheckpointManager:
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
+        self._finalize_digests()
         self._mgr.close()
 
     def __enter__(self) -> "CheckpointManager":

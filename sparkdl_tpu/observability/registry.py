@@ -265,6 +265,21 @@ class MetricFamily:
                 out[label_str] = v
         return out
 
+    def labelled_values(self, label: str) -> dict:
+        """Scalar series keyed by ONE label dimension's value —
+        the structured accessor for programmatic consumers (parsing the
+        rendered ``snapshot_values`` label strings is a format
+        coupling). Series that collide on the chosen dimension (the
+        family has other label dimensions too) are summed, never
+        silently overwritten. Histogram series are skipped."""
+        idx = self.label_names.index(label)
+        out: dict = {}
+        for key, v in self._copy_series():
+            if isinstance(v, _Hist):
+                continue
+            out[key[idx]] = out.get(key[idx], 0.0) + v
+        return out
+
     def render_prometheus(self, lines: "list[str]") -> None:
         items = sorted(self._copy_series())
         if not items:

@@ -1,0 +1,67 @@
+"""Reliability: deterministic fault injection, retry/backoff, recovery.
+
+The ROADMAP's north star is serving heavy production traffic, and the
+TensorFlow system paper (PAPERS.md) treats fault tolerance as a design
+axis co-equal with performance — yet until this package a single thrown
+exception ended a finetune run, a quarantined replica was dead forever,
+and no failure path could be tested deterministically. Three pillars:
+
+* :mod:`~sparkdl_tpu.reliability.faults` — a deterministic
+  fault-injection harness: a :class:`FaultPlan` (from code or the
+  ``SPARKDL_TPU_FAULT_PLAN`` env var) arms named sites — ``dispatch``,
+  ``fetch``, ``replica.execute``, ``checkpoint.save``, ``worker.rank``
+  — to raise a chosen exception on the Nth hit or with a seeded
+  probability. Every production hot path carries a
+  :func:`fault_point` that costs one global load when disarmed.
+* :mod:`~sparkdl_tpu.reliability.retry` — :class:`RetryPolicy`:
+  bounded attempts, exponential backoff with full jitter, a per-process
+  retry budget, retryable-vs-fatal classification, and
+  ``sparkdl_retries_total{site,outcome}`` metrics + ``retry.attempt``
+  spans in the observability spine.
+* :mod:`~sparkdl_tpu.reliability.supervisor` —
+  :func:`resumable_finetune`: a crash (real or injected) mid-finetune
+  restores the latest intact checkpoint, replays the data iterator to
+  the restored step, and continues under the retry policy — the
+  recovered per-step loss trajectory is bitwise-identical to an
+  uninterrupted run.
+
+The serving side builds on the same pieces: ``ReplicaPool`` quarantine
+is a circuit breaker (probation probes with backoff, rejoin on
+success), a micro-batch whose replica dies is re-routed once before its
+riders see an error, and a hung dispatch is failed on a deadline
+instead of wedging the pool (:mod:`sparkdl_tpu.serving.replicas`).
+"""
+
+from sparkdl_tpu.reliability.faults import (
+    FaultPlan,
+    FaultRule,
+    active_plan,
+    arm,
+    disarm,
+    fault_point,
+    inject,
+)
+from sparkdl_tpu.reliability.retry import (
+    RetryBudget,
+    RetryExhaustedError,
+    RetryPolicy,
+    process_retry_budget,
+    record_retry,
+)
+from sparkdl_tpu.reliability.supervisor import resumable_finetune
+
+__all__ = [
+    "FaultPlan",
+    "FaultRule",
+    "RetryBudget",
+    "RetryExhaustedError",
+    "RetryPolicy",
+    "active_plan",
+    "arm",
+    "disarm",
+    "fault_point",
+    "inject",
+    "process_retry_budget",
+    "record_retry",
+    "resumable_finetune",
+]

@@ -1,0 +1,120 @@
+"""Resumable training: crash → restore → replay → continue, exactly.
+
+``finetune_classifier`` already checkpoints and already skips
+already-trained steps on restart — what was missing is the *loop*: a
+process that crashes (preemption, injected fault, transient device
+error) simply died with its history. :func:`resumable_finetune` closes
+the loop under a :class:`~sparkdl_tpu.reliability.retry.RetryPolicy`:
+
+1. run an attempt; on a retryable failure, back off (full jitter);
+2. the next attempt restores the newest *intact* checkpoint
+   (``CheckpointManager.restore`` falls back past torn writes), replays
+   the data iterator to the restored step, and continues;
+3. per-step metrics are merged across attempts by step number — re-run
+   steps (between the restored checkpoint and the crash point) recompute
+   bitwise-identical values, so the recovered loss trajectory equals an
+   uninterrupted run's exactly (pinned by tests and the run-tests.sh
+   fault-injection smoke).
+
+The barrier-retry resume story of SURVEY.md §5, productionized: what a
+Spark stage retry does for a whole barrier job, this does in-process for
+a single-host run.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterable
+
+from sparkdl_tpu.reliability.retry import RetryPolicy
+
+__all__ = ["resumable_finetune"]
+
+_log = logging.getLogger(__name__)
+
+#: Default classification for training crashes: retry anything except
+#: clear programming errors — a preemption surfaces as RuntimeError /
+#: OSError / a jax runtime error, all of which deserve a resume.
+_DEFAULT_POLICY = dict(
+    max_attempts=3,
+    base_delay_s=0.05,
+    max_delay_s=5.0,
+    fatal=(TypeError, AssertionError),
+)
+
+
+def resumable_finetune(
+    apply_fn: Callable[..., Any],
+    params: Any,
+    make_batches: "Callable[[], Iterable[dict]] | list[dict]",
+    *,
+    checkpoint_dir: str,
+    retry: "RetryPolicy | None" = None,
+    metrics_cb: "Callable[[dict], None] | None" = None,
+    **finetune_kwargs: Any,
+) -> "tuple[Any, list[dict]]":
+    """``finetune_classifier`` that survives crashes mid-run.
+
+    ``make_batches`` must be replayable: a zero-arg callable returning a
+    fresh deterministic iterator (``lambda: batches_from_arrays(...)``)
+    or a list of batches. A plain one-shot iterator cannot replay after
+    a crash and is rejected loudly.
+
+    ``checkpoint_dir`` is required — it is the recovery mechanism: each
+    attempt resumes from the newest intact checkpoint in it (none on the
+    first attempt = start from scratch). ``retry`` defaults to 3
+    attempts with full-jitter backoff against the process retry budget.
+
+    Returns ``(params, history)`` exactly like ``finetune_classifier``;
+    ``history`` is merged across attempts by step, so it covers the full
+    trajectory even though late attempts only run the tail. Re-run steps
+    (restored checkpoint → crash point) recompute identical entries —
+    recovery parity is bitwise, not approximate.
+    """
+    if not checkpoint_dir:
+        raise ValueError(
+            "resumable_finetune requires checkpoint_dir — the checkpoint "
+            "IS the recovery mechanism"
+        )
+    if not callable(make_batches) and not isinstance(
+            make_batches, (list, tuple)):
+        raise TypeError(
+            "make_batches must be a zero-arg callable returning a fresh "
+            "iterator, or a list of batches — a one-shot iterator cannot "
+            f"be replayed after a crash (got {type(make_batches).__name__})"
+        )
+    if retry is None:
+        retry = RetryPolicy(**_DEFAULT_POLICY)
+
+    from sparkdl_tpu.train.finetune import finetune_classifier
+
+    #: step -> metrics entry, merged across attempts. Entries re-emitted
+    #: by a replayed step overwrite with bitwise-identical values.
+    entries: "dict[int, dict]" = {}
+
+    def merge_cb(m: dict) -> None:
+        entries[int(m["step"])] = m
+        if metrics_cb is not None:
+            metrics_cb(m)
+
+    attempts = {"n": 0}
+
+    def attempt():
+        attempts["n"] += 1
+        if attempts["n"] > 1:
+            _log.warning(
+                "resumable_finetune: attempt %d resuming from %s",
+                attempts["n"], checkpoint_dir,
+            )
+        batches = (make_batches() if callable(make_batches)
+                   else make_batches)
+        return finetune_classifier(
+            apply_fn, params, batches,
+            checkpoint_dir=checkpoint_dir,
+            metrics_cb=merge_cb,
+            **finetune_kwargs,
+        )
+
+    final_params, _ = retry.call(attempt, site="train.run")
+    history = [entries[s] for s in sorted(entries)]
+    return final_params, history

@@ -53,6 +53,16 @@ def main(argv: list[str]) -> int:
     fn = payload["fn"]
     kwargs = payload["kwargs"]
     try:
+        # Deterministic rank-crash site (reliability/faults.py): the plan
+        # rides the inherited environment (SPARKDL_TPU_FAULT_PLAN), so a
+        # parent can arm "worker.rank" (any rank — each child counts its
+        # own hits) or "worker.rank.<r>" (that rank only) and the child
+        # kills itself — the preemption drill for the backend's
+        # peer-teardown watchdog.
+        from sparkdl_tpu.reliability.faults import fault_point
+
+        fault_point("worker.rank")
+        fault_point(f"worker.rank.{rank}")
         result = fn(**kwargs)
     except Exception:
         traceback.print_exc()

@@ -155,10 +155,21 @@ class LocalProcessBackend:
     """
 
     def __init__(self, devices_per_process: int = 1, platform: "str | None" = "cpu",
-                 timeout_s: float = 600.0):
+                 timeout_s: float = 600.0,
+                 straggler_grace_s: "float | None" = None):
         self.devices_per_process = devices_per_process
         self.platform = platform
         self.timeout_s = timeout_s
+        #: Rank watchdog (reliability layer): once the FIRST rank exits,
+        #: surviving ranks get this many extra seconds before they are
+        #: torn down as hung. An SPMD job's ranks finish near-together;
+        #: a rank still running long after its peers is wedged in a
+        #: collective its peers already left (e.g. the metrics rollup
+        #: when a sibling died uncleanly) and would otherwise block the
+        #: job for the full timeout_s. None disables (default: legit
+        #: skew — rank 0 pickling a large result — must not be killed
+        #: by an over-eager default).
+        self.straggler_grace_s = straggler_grace_s
 
     def run(self, nprocs: int, fn: Callable, kwargs: dict,
             verbosity: str = "all") -> Any:
@@ -215,7 +226,8 @@ class LocalProcessBackend:
                 t.start()
                 streams.append(t)
 
-            failed = _wait_all(procs, self.timeout_s)
+            failed = _wait_all(procs, self.timeout_s,
+                               self.straggler_grace_s)
             for t in streams:
                 t.join(timeout=5)
             if failed:
@@ -241,8 +253,14 @@ def _stream_output(proc: subprocess.Popen, rank: int, verbosity: str) -> None:
             logger.debug("[rank %d] %s", rank, line.rstrip())
 
 
-def _wait_all(procs: list[subprocess.Popen], timeout_s: float) -> list[int]:
+def _wait_all(procs: list[subprocess.Popen], timeout_s: float,
+              straggler_grace_s: "float | None" = None) -> list[int]:
     """Wait for every rank; on first failure or timeout kill the rest.
+
+    ``straggler_grace_s`` is the rank watchdog: once the first rank has
+    exited (cleanly), ranks still running past the grace window are
+    declared hung and torn down — without it a single wedged rank holds
+    the job until the global ``timeout_s``.
 
     Returns the list of failed ranks (empty on success).
     """
@@ -251,6 +269,7 @@ def _wait_all(procs: list[subprocess.Popen], timeout_s: float) -> list[int]:
     deadline = time.monotonic() + timeout_s
     pending = dict(enumerate(procs))
     failed: list[int] = []
+    first_exit_at: "float | None" = None
     while pending and not failed:
         for rank, p in list(pending.items()):
             rc = p.poll()
@@ -259,7 +278,22 @@ def _wait_all(procs: list[subprocess.Popen], timeout_s: float) -> list[int]:
             del pending[rank]
             if rc != 0:
                 failed.append(rank)
-        if time.monotonic() > deadline:
+        now = time.monotonic()
+        if (pending and first_exit_at is None
+                and len(pending) < len(procs)):
+            first_exit_at = now
+        if (pending and not failed
+                and straggler_grace_s is not None
+                and first_exit_at is not None
+                and now > first_exit_at + straggler_grace_s):
+            logger.error(
+                "rank watchdog: rank(s) %s still running %.1fs after "
+                "the first rank exited; tearing down as hung",
+                sorted(pending), straggler_grace_s,
+            )
+            failed.extend(pending.keys())
+            break
+        if now > deadline:
             failed.extend(pending.keys())
             break
         time.sleep(0.05)

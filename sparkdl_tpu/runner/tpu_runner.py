@@ -32,7 +32,8 @@ class TPURunner:
                  backend=None, devices_per_process: int = 1,
                  local_platform: "str | None" = "cpu",
                  timeout_s: float = 600.0,
-                 metrics_summary: bool = False):
+                 metrics_summary: bool = False,
+                 straggler_grace_s: "float | None" = None):
         if np == 0:
             raise ValueError("np must be a non-zero integer")
         if driver_log_verbosity not in _VERBOSITIES:
@@ -46,6 +47,11 @@ class TPURunner:
         self._devices_per_process = devices_per_process
         self._local_platform = local_platform
         self._timeout_s = timeout_s
+        #: rank watchdog grace (local mode): once the first rank exits,
+        #: survivors past this window are torn down as hung instead of
+        #: blocking peers (e.g. in the collective metrics rollup) until
+        #: timeout_s. None = disabled.
+        self._straggler_grace_s = straggler_grace_s
 
     def run(self, main: Callable, **kwargs: Any) -> Any:
         """Run ``main(**kwargs)`` on all ranks; returns rank 0's result.
@@ -73,6 +79,7 @@ class TPURunner:
                 devices_per_process=self._devices_per_process,
                 platform=self._local_platform,
                 timeout_s=self._timeout_s,
+                straggler_grace_s=self._straggler_grace_s,
             )
         try:
             return SparkBarrierBackend()

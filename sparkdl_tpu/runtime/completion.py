@@ -51,6 +51,7 @@ import numpy as np
 
 from sparkdl_tpu.observability import tracing
 from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.reliability.faults import fault_point
 
 __all__ = [
     "AsyncFetcher",
@@ -234,6 +235,7 @@ def start_fetch(tree: Any, *, path: str = "default") -> FetchTicket:
     array types) ride the bounded readback thread pool instead; plain
     host arrays pass through untouched either way.
     """
+    fault_point("fetch")
     fetches, _, inflight = fetch_metrics()
     fetches.inc(path=path)
     inflight.inc()

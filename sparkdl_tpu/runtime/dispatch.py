@@ -47,6 +47,7 @@ from typing import Any, Callable, Iterable, Iterator
 
 from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.observability.tracing import span
+from sparkdl_tpu.reliability.faults import fault_point
 
 __all__ = [
     "ChainPolicy",
@@ -347,6 +348,7 @@ class ScanChainer:
         ``serving.device_step`` span and record path="serving".)"""
         import jax
 
+        fault_point("dispatch")
         t0 = time.perf_counter()
         with span("dispatch.chain", path=self.path, k=1):
             y = self.jit_single(x)
@@ -364,6 +366,7 @@ class ScanChainer:
         k = len(xs)
         if k == 1:
             return [self.dispatch_single(xs[0])]
+        fault_point("dispatch")
         t0 = time.perf_counter()
         with span("dispatch.chain", path=self.path, k=k):
             ys = self._jit_chained(*xs)

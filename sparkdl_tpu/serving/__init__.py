@@ -42,9 +42,11 @@ from sparkdl_tpu.serving.queue import (
     QueueFullError,
     Request,
     RequestQueue,
+    failure_reason,
 )
 from sparkdl_tpu.serving.replicas import (
     AllReplicasQuarantinedError,
+    HungDispatchError,
     ReplicaPool,
 )
 
@@ -54,6 +56,7 @@ __all__ = [
     "DeadlineExceededError",
     "EngineClosedError",
     "GenRequest",
+    "HungDispatchError",
     "MicroBatcher",
     "QueueFullError",
     "ReplicaPool",
@@ -61,4 +64,5 @@ __all__ = [
     "RequestQueue",
     "ServingEngine",
     "ServingMetrics",
+    "failure_reason",
 ]

@@ -41,6 +41,7 @@ from sparkdl_tpu.serving.queue import (
     EngineClosedError,
     Request,
     RequestQueue,
+    record_request_failure,
 )
 
 
@@ -327,6 +328,7 @@ class ContinuousGPTEngine:
                         free.insert(0, slot)
                         if not req.future.done():
                             req.future.set_exception(e)
+                            record_request_failure(e)
                             self.metrics.record_request(
                                 now - req.enqueued, ok=False
                             )
@@ -467,10 +469,12 @@ class ContinuousGPTEngine:
             flight = self._inflight[slot]
             if flight.req.expired(now):
                 self._inflight.pop(slot)
-                flight.req.future.set_exception(DeadlineExceededError(
+                exc = DeadlineExceededError(
                     "deadline exceeded mid-decode "
                     f"({len(flight.produced)}/{flight.max_new} tokens)"
-                ))
+                )
+                flight.req.future.set_exception(exc)
+                record_request_failure(exc)
                 self.metrics.record_request(
                     now - flight.req.enqueued, ok=False
                 )
@@ -480,6 +484,7 @@ class ContinuousGPTEngine:
             flight = self._inflight.pop(slot)
             if not flight.req.future.done():
                 flight.req.future.set_exception(exc)
+                record_request_failure(exc)
                 self.metrics.record_request(
                     time.monotonic() - flight.req.enqueued, ok=False
                 )

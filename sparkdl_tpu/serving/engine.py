@@ -71,13 +71,21 @@ class ServingEngine:
     def snapshot(self) -> dict:
         """Operator metrics: queue depth, occupancy, latency p50/p95/p99,
         admission counters — plus per-replica depth/in-flight/quarantine
-        state when the runner is a ReplicaPool."""
+        state when the runner is a ReplicaPool, and the process-wide
+        shed-load breakdown (``requests_failed_by_reason``, from the
+        reliability layer's ``sparkdl_requests_failed_total`` counter)."""
         snap = self.metrics.snapshot(self.queue)
         pool_snapshot = getattr(self.runner, "snapshot", None)
         if callable(pool_snapshot):
             snap.update(pool_snapshot())
         else:
             snap["replica_count"] = 1
+        from sparkdl_tpu.observability.registry import registry
+
+        fam = registry().get("sparkdl_requests_failed_total")
+        snap["requests_failed_by_reason"] = (
+            fam.labelled_values("reason") if fam else {}
+        )
         return snap
 
     def __enter__(self) -> "ServingEngine":

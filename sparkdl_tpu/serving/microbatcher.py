@@ -38,7 +38,11 @@ import numpy as np
 from sparkdl_tpu.observability import tracing
 from sparkdl_tpu.observability.tracing import span
 from sparkdl_tpu.serving.metrics import ServingMetrics
-from sparkdl_tpu.serving.queue import Request, RequestQueue
+from sparkdl_tpu.serving.queue import (
+    Request,
+    RequestQueue,
+    record_request_failure,
+)
 from sparkdl_tpu.transformers._inference import BatchedRunner, try_extract
 
 _log = logging.getLogger(__name__)
@@ -272,6 +276,9 @@ class MicroBatcher:
                 error: Exception | None = None) -> None:
         latency = time.monotonic() - req.enqueued
         if error is not None:
+            # shed load must be observable: every accepted-then-failed
+            # request lands in the reason-labelled registry counter
+            record_request_failure(error)
             req.future.set_exception(error)
         else:
             req.future.set_result(result)

@@ -40,6 +40,11 @@ _M_DEPTH = registry().gauge(
     "sparkdl_queue_depth", "currently queued requests, all queues")
 _M_WAIT = registry().histogram(
     "sparkdl_queue_wait_seconds", "queue wait, submit to take")
+_M_FAILED = registry().counter(
+    "sparkdl_requests_failed_total",
+    "accepted requests that resolved with an error, by reason "
+    "(closed/expired/replica_lost/retry_exhausted/error)",
+    labels=("reason",))
 
 
 class QueueFullError(RuntimeError):
@@ -52,6 +57,32 @@ class DeadlineExceededError(TimeoutError):
 
 class EngineClosedError(RuntimeError):
     """Submit after close(): the engine is draining or stopped."""
+
+
+def failure_reason(exc: BaseException) -> str:
+    """Classify a request-failing exception for the shed-load counter.
+
+    Name-based matches keep this module import-light: the replica-pool
+    and retry errors live in modules this one must not depend on.
+    """
+    if isinstance(exc, EngineClosedError):
+        return "closed"
+    if isinstance(exc, DeadlineExceededError):
+        return "expired"
+    name = type(exc).__name__
+    if name in ("AllReplicasQuarantinedError", "HungDispatchError"):
+        return "replica_lost"
+    if name == "RetryExhaustedError":
+        return "retry_exhausted"
+    return "error"
+
+
+def record_request_failure(exc: BaseException) -> None:
+    """Land one failed-request outcome in the registry
+    (``sparkdl_requests_failed_total{reason=...}``) so shed load is
+    observable — called by every path that fails an accepted request's
+    Future (queue sweeps, drains, and the micro-batcher)."""
+    _M_FAILED.inc(reason=failure_reason(exc))
 
 
 @dataclasses.dataclass
@@ -75,10 +106,12 @@ class Request:
     def fail_expired(self) -> None:
         # a future the caller already cancelled cannot take an exception
         if self.future.set_running_or_notify_cancel():
-            self.future.set_exception(DeadlineExceededError(
+            exc = DeadlineExceededError(
                 f"deadline exceeded after "
                 f"{time.monotonic() - self.enqueued:.3f}s in queue"
-            ))
+            )
+            record_request_failure(exc)
+            self.future.set_exception(exc)
 
 
 class RequestQueue:
@@ -135,7 +168,13 @@ class RequestQueue:
                timeout_s: float | None = None) -> Future:
         """Enqueue; returns the request's Future. Raises
         :class:`QueueFullError` at capacity (after sweeping expired
-        entries) and :class:`EngineClosedError` after close()."""
+        entries) and :class:`EngineClosedError` after close().
+
+        Submit vs a concurrent ``close()`` is deterministic: both take
+        the queue's condition lock, so a submit either wins the race (its
+        request was accepted and WILL be drained — ``close()`` keeps
+        queued work takeable) or raises ``EngineClosedError`` — never a
+        silently dropped Future (pinned by tests)."""
         now = time.monotonic()
         deadline = now + timeout_s if timeout_s is not None else None
         with self._cv:
@@ -216,7 +255,9 @@ class RequestQueue:
 
     def fail_pending(self, exc: BaseException | None = None) -> int:
         """Fail every queued request (non-graceful shutdown). Returns the
-        number failed."""
+        number failed. Each failure lands in
+        ``sparkdl_requests_failed_total`` under the exception's reason
+        (``closed`` for the default shutdown error)."""
         if exc is None:
             exc = EngineClosedError("engine shut down before dispatch")
         n = 0
@@ -224,6 +265,7 @@ class RequestQueue:
             while self._dq:
                 req = self._dq.popleft()
                 if req.future.set_running_or_notify_cancel():
+                    record_request_failure(exc)
                     req.future.set_exception(exc)
                 else:
                     self.cancelled += 1

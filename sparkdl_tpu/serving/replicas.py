@@ -20,12 +20,28 @@ Contracts:
   broken round-robin. Per-replica depth/latency land in the metrics
   spine (``sparkdl_replica_depth{replica=...}``,
   ``sparkdl_replica_batch_seconds{replica=...}``).
-- **Failure isolation**: a failed batch surfaces ITS error on ITS
-  future (the micro-batcher's poison-row fallback then retries rows
-  individually — routed to healthy replicas). ``max_failures``
-  *consecutive* executor failures quarantine the replica: it stops
-  taking work, its queue re-routes, and the pool keeps serving on the
-  survivors. Only an all-replicas-quarantined pool refuses work.
+- **Failure isolation with rider protection**: a batch whose executor
+  fails is **re-routed once** to a different replica before its riders
+  ever see an error (``sparkdl_retries_total{site="replica.execute"}``
+  counts it); only a second failure surfaces. The micro-batcher's
+  poison-row fallback then still retries rows individually.
+- **Quarantine is a circuit breaker, not a death sentence**:
+  ``max_failures`` *consecutive* executor failures quarantine the
+  replica — it stops taking work, its queue re-routes — but after
+  ``probation_s`` it receives ONE probation probe (a live batch, rider
+  protected by the re-route). Probe success reintegrates the replica
+  (``sparkdl_replica_reintegrated_total``); probe failure doubles the
+  backoff up to ``probation_max_s``. Only an all-quarantined,
+  none-probeable pool refuses work.
+- **Hung-dispatch watchdog**: with ``dispatch_timeout_s`` set, a
+  dispatch that exceeds the deadline is taken away from its replica —
+  re-routed under the same rider protection as an executor error, so
+  :class:`HungDispatchError` only surfaces once re-routes are exhausted
+  — and the replica is quarantined as hung
+  (``sparkdl_replica_hung_total``) instead of wedging the pool. The
+  hung-freeze (no probation probes) lifts as soon as the wedged program
+  resolves either way: a late success rejoins the replica directly, a
+  late error re-enters the normal probation cycle.
 - **Drain**: ``close(drain=True)`` serves every accepted batch before
   stopping; ``drain=False`` fails queued batches immediately.
 
@@ -42,61 +58,125 @@ import queue as queue_mod
 import threading
 import time
 from concurrent.futures import TimeoutError as FuturesTimeoutError
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
 import numpy as np
 
 from sparkdl_tpu.observability.metrics import StepMeter
 from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.observability.tracing import span
+from sparkdl_tpu.reliability.faults import fault_point
+from sparkdl_tpu.reliability.retry import record_retry
 from sparkdl_tpu.transformers._inference import BatchedRunner
 
-__all__ = ["AllReplicasQuarantinedError", "ReplicaPool"]
+__all__ = [
+    "AllReplicasQuarantinedError",
+    "HungDispatchError",
+    "ReplicaPool",
+]
 
 _log = logging.getLogger(__name__)
 
 _METRICS = None
 
 
-def _metrics():
-    """Lazy spine handles: (depth gauge, batch-wall histogram, batches
-    counter, quarantine counter), all labelled by replica index."""
+class _PoolMetrics(NamedTuple):
+    """Lazy spine handles; the first three are labelled by replica."""
+
+    depth: Any
+    batch_seconds: Any
+    batches: Any
+    quarantined: Any
+    reintegrated: Any
+    hung: Any
+
+
+def _metrics() -> _PoolMetrics:
     global _METRICS
     if _METRICS is None:
-        _METRICS = (
-            registry().gauge(
+        _METRICS = _PoolMetrics(
+            depth=registry().gauge(
                 "sparkdl_replica_depth",
                 "batches queued+running on each serving replica",
                 labels=("replica",)),
-            registry().histogram(
+            batch_seconds=registry().histogram(
                 "sparkdl_replica_batch_seconds",
                 "per-replica batch wall time, dispatch to host result",
                 labels=("replica",)),
-            registry().counter(
+            batches=registry().counter(
                 "sparkdl_replica_batches_total",
                 "batches served by each replica", labels=("replica",)),
-            registry().counter(
+            quarantined=registry().counter(
                 "sparkdl_replica_quarantined_total",
                 "replicas quarantined after repeated executor failures"),
+            reintegrated=registry().counter(
+                "sparkdl_replica_reintegrated_total",
+                "quarantined replicas that rejoined after a successful "
+                "probation probe"),
+            hung=registry().counter(
+                "sparkdl_replica_hung_total",
+                "dispatches failed by the hung-dispatch watchdog"),
         )
     return _METRICS
 
 
 class AllReplicasQuarantinedError(RuntimeError):
-    """Every replica in the pool has been quarantined; the pool cannot
-    accept work until it is rebuilt."""
+    """Every replica in the pool is quarantined and none is due a
+    probation probe; the pool cannot accept work right now."""
+
+
+class HungDispatchError(TimeoutError):
+    """A dispatch exceeded the pool's ``dispatch_timeout_s`` deadline
+    and was failed by the watchdog (its replica is quarantined as
+    hung)."""
 
 
 class _Work:
-    """One routed micro-batch: arrays in, Future-like out."""
+    """One routed micro-batch: arrays in, Future-like out.
 
-    __slots__ = ("arrays", "result", "exc", "done")
+    Resolution is idempotent (``finish``/``fail`` first-writer-wins):
+    the hung-dispatch watchdog may fail a batch whose wedged executor
+    later completes it — the late result is discarded, never raced.
+    """
+
+    __slots__ = ("arrays", "result", "exc", "done", "retries", "probe",
+                 "reroutable", "owner", "started_at", "_lock")
 
     def __init__(self, arrays: dict[str, np.ndarray]):
         self.arrays = arrays
         self.result: Any = None
         self.exc: "BaseException | None" = None
         self.done = threading.Event()
+        #: re-routes consumed (rider protection: at most max_reroutes)
+        self.retries = 0
+        #: replica currently responsible for resolving this work. The
+        #: watchdog re-routes work whose executor is WEDGED (still
+        #: running), so two replicas can hold the same work — only the
+        #: owner's FAILURE may resolve it (a stale success is harmless:
+        #: same program, same arrays, identical payload).
+        self.owner: "object | None" = None
+        #: warmup pins work to ONE replica: re-routing its batch would
+        #: mask that replica's compile failure as a pool-wide success
+        self.reroutable = True
+        #: this routing is a probation probe of a quarantined replica
+        self.probe = False
+        #: monotonic start of the in-flight dispatch (watchdog input)
+        self.started_at: "float | None" = None
+        self._lock = threading.Lock()
+
+    def finish(self, result: Any) -> None:
+        with self._lock:
+            if self.done.is_set():
+                return  # watchdog got here first: late result discarded
+            self.result = result
+            self.done.set()
+
+    def fail(self, exc: BaseException) -> None:
+        with self._lock:
+            if self.done.is_set():
+                return
+            self.exc = exc
+            self.done.set()
 
     # Future-like surface (what MicroBatcher/BatchResult callers use)
     def wait_result(self, timeout: "float | None" = None):
@@ -139,6 +219,17 @@ class _Replica:
         self.dispatched = 0
         self.consecutive_failures = 0
         self.quarantined = False
+        #: quarantined because the watchdog caught a wedged dispatch:
+        #: no probation probes until the wedged program resolves (probing
+        #: would queue live work behind a stuck thread)
+        self.hung = False
+        #: a probation probe is in flight (at most one at a time)
+        self.probing = False
+        #: monotonic time the next probation probe becomes due
+        self.probation_until = 0.0
+        self.probation_backoff_s = pool.probation_s or 0.0
+        #: the in-flight work item, if any (watchdog scan target)
+        self.current_work: "_Work | None" = None
         self.latency = StepMeter(n_chips=1, window=256, warmup_steps=0)
         self.thread = threading.Thread(
             target=self._loop, name=f"sparkdl-replica-{index}", daemon=True
@@ -146,33 +237,49 @@ class _Replica:
         self.thread.start()
 
     def _loop(self) -> None:
-        depth, wall_hist, batches, _ = _metrics()
+        m = _metrics()
+        depth, wall_hist, batches = m.depth, m.batch_seconds, m.batches
         label = str(self.index)
         while True:
             work = self.queue.get()
             if work is None:
                 return
+            work.started_at = time.monotonic()
+            self.current_work = work
             t0 = time.perf_counter()
+            exc: "Exception | None" = None
+            result = None
             try:
                 with span("serving.replica_batch", replica=self.index):
-                    work.result = self.runner.run_batch(work.arrays)
+                    fault_point("replica.execute")
+                    result = self.runner.run_batch(work.arrays)
             except BaseException as e:
-                work.exc = e if isinstance(e, Exception) else RuntimeError(
+                exc = e if isinstance(e, Exception) else RuntimeError(
                     f"replica {self.index} executor died: {e!r}"
                 )
-                self.pool._on_failure(self)
-            else:
-                self.pool._on_success(self)
-            finally:
-                wall = time.perf_counter() - t0
+            wall = time.perf_counter() - t0
+            self.dispatched += 1
+            self.current_work = None
+            with self.pool._lock:
+                self.outstanding -= 1
+            # the work MUST resolve no matter what the accounting below
+            # does — an unresolved _Work strands its caller forever, so
+            # even the metrics calls live inside this guard
+            try:
+                depth.set(self.outstanding, replica=label)
                 wall_hist.observe(wall, replica=label)
                 batches.inc(replica=label)
                 self.latency.record(wall, examples=1)
-                self.dispatched += 1
-                with self.pool._lock:
-                    self.outstanding -= 1
-                    depth.set(self.outstanding, replica=label)
-                work.done.set()
+                if exc is None:
+                    self.pool._on_success(self, work)
+                    work.finish(result)
+                else:
+                    self.pool._on_failure(self, work, exc)
+            except BaseException as account_exc:  # pragma: no cover
+                work.fail(exc if exc is not None else account_exc)
+                _log.exception(
+                    "replica %d failure accounting raised", self.index
+                )
 
 
 class ReplicaPool:
@@ -186,6 +293,14 @@ class ReplicaPool:
     device; passing more replicas than devices round-robins devices
     ("simulated replicas" — how the CPU harness exercises N-way routing
     on one chip).
+
+    Reliability knobs: ``max_failures`` consecutive failures open the
+    circuit breaker; ``probation_s`` (None disables probes → permanent
+    quarantine, the pre-reliability behavior) schedules the first
+    probation probe, doubling per failed probe up to
+    ``probation_max_s``; ``max_reroutes`` bounds rider-protecting
+    re-routes per batch; ``dispatch_timeout_s`` (None disables) arms the
+    hung-dispatch watchdog.
     """
 
     def __init__(self, apply_fn: "Callable | None" = None, *,
@@ -194,6 +309,10 @@ class ReplicaPool:
                  n_replicas: "int | None" = None,
                  make_runner: "Callable[[Any], BatchedRunner] | None" = None,
                  max_failures: int = 3,
+                 probation_s: "float | None" = 1.0,
+                 probation_max_s: float = 30.0,
+                 max_reroutes: int = 1,
+                 dispatch_timeout_s: "float | None" = None,
                  **runner_kwargs):
         import jax
 
@@ -203,6 +322,17 @@ class ReplicaPool:
             )
         if max_failures < 1:
             raise ValueError(f"max_failures must be >= 1, got {max_failures}")
+        if probation_s is not None and probation_s <= 0:
+            raise ValueError(
+                f"probation_s must be > 0 or None, got {probation_s}"
+            )
+        if max_reroutes < 0:
+            raise ValueError(f"max_reroutes must be >= 0, got {max_reroutes}")
+        if dispatch_timeout_s is not None and dispatch_timeout_s <= 0:
+            raise ValueError(
+                f"dispatch_timeout_s must be > 0 or None, got "
+                f"{dispatch_timeout_s}"
+            )
         if devices is None:
             devices = list(jax.local_devices())
         if n_replicas is None:
@@ -216,8 +346,13 @@ class ReplicaPool:
                     device=device, **runner_kwargs,
                 )
         self.max_failures = max_failures
+        self.probation_s = probation_s
+        self.probation_max_s = probation_max_s
+        self.max_reroutes = max_reroutes
+        self.dispatch_timeout_s = dispatch_timeout_s
         self._lock = threading.Lock()
         self._closed = False
+        self._closing = threading.Event()
         self._rr = 0  # round-robin tiebreak cursor
         self.replicas = [
             _Replica(i, devices[i % len(devices)],
@@ -225,6 +360,13 @@ class ReplicaPool:
             for i in range(n_replicas)
         ]
         self._worker_ids = {r.thread.ident: r for r in self.replicas}
+        self._watchdog: "threading.Thread | None" = None
+        if dispatch_timeout_s is not None:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="sparkdl-pool-watchdog",
+                daemon=True,
+            )
+            self._watchdog.start()
 
     # -- the BatchedRunner-compatible surface --------------------------------
     @property
@@ -255,71 +397,279 @@ class ReplicaPool:
         return self.run_batch_async(arrays).result()
 
     # -- routing -------------------------------------------------------------
-    def _route(self, work: _Work) -> None:
-        depth, _, _, _ = _metrics()
+    def _route(self, work: _Work, exclude: "_Replica | None" = None) -> None:
+        depth = _metrics().depth
         with self._lock:
             if self._closed:
                 raise RuntimeError("ReplicaPool is closed")
-            healthy = [r for r in self.replicas if not r.quarantined]
-            if not healthy:
-                raise AllReplicasQuarantinedError(
-                    f"all {len(self.replicas)} replicas quarantined "
-                    f"(>{self.max_failures} consecutive failures each); "
-                    "rebuild the pool"
-                )
-            # least outstanding work; round-robin among ties so idle
-            # replicas share the trickle load instead of replica 0
-            # absorbing it all
-            best = min(r.outstanding for r in healthy)
-            ties = [r for r in healthy if r.outstanding == best]
-            replica = ties[self._rr % len(ties)]
-            self._rr += 1
+            replica = self._pick_locked(work, exclude)
             replica.outstanding += 1
+            work.owner = replica
             depth.set(replica.outstanding, replica=str(replica.index))
         replica.queue.put(work)
 
-    # -- failure accounting (called from worker threads) ---------------------
-    def _on_success(self, replica: _Replica) -> None:
-        replica.consecutive_failures = 0
+    def _pick_locked(self, work: _Work,
+                     exclude: "_Replica | None") -> _Replica:
+        now = time.monotonic()
+        # probation probe: a quarantined (not hung) replica whose backoff
+        # elapsed takes this batch as its probe — the rider is protected
+        # by the re-route-once retry, so a failed probe costs latency,
+        # never a result. First-time routings only: a batch already
+        # burned by one replica must land somewhere trustworthy.
+        # (max_reroutes=0 disables probes too: a probe's rider is only
+        # protected by the re-route, and "a failed probe costs latency,
+        # never a result" is the contract.) One documented exception: in
+        # an ALL-quarantined pool the probe has no healthy re-route
+        # target — but without the probe this rider was getting
+        # AllReplicasQuarantinedError anyway (and the pool could never
+        # self-heal), so the last-ditch probe can only improve its odds;
+        # _retry_or_fail surfaces that same typed error if it fails.
+        if (self.probation_s is not None and self.max_reroutes >= 1
+                and work.retries == 0):
+            for r in self.replicas:
+                if (r is not exclude and r.quarantined and not r.hung
+                        and not r.probing and now >= r.probation_until):
+                    r.probing = True
+                    work.probe = True
+                    return r
+        healthy = [r for r in self.replicas
+                   if not r.quarantined and r is not exclude]
+        if not healthy:
+            if exclude is not None:
+                raise RuntimeError(
+                    f"no alternative replica to re-route off replica "
+                    f"{exclude.index}"
+                )
+            raise AllReplicasQuarantinedError(
+                f"all {len(self.replicas)} replicas quarantined "
+                f"(>{self.max_failures} consecutive failures each) and "
+                "none is due a probation probe yet"
+            )
+        # least outstanding work; round-robin among ties so idle
+        # replicas share the trickle load instead of replica 0
+        # absorbing it all
+        best = min(r.outstanding for r in healthy)
+        ties = [r for r in healthy if r.outstanding == best]
+        replica = ties[self._rr % len(ties)]
+        self._rr += 1
+        return replica
 
-    def _on_failure(self, replica: _Replica) -> None:
-        replica.consecutive_failures += 1
-        if (replica.consecutive_failures >= self.max_failures
-                and not replica.quarantined):
-            with self._lock:
-                replica.quarantined = True
-            _metrics()[3].inc()
+    # -- success/failure accounting (called from worker threads) -------------
+    def _on_success(self, replica: _Replica, work: _Work) -> None:
+        rejoined = False
+        with self._lock:
+            # resolution claim (mirrors _on_failure): a watchdogged
+            # dispatch that finally succeeded AFTER its work was
+            # re-routed still heals the replica below, but the per-work
+            # outcome (the "recovered" retry metric) belongs to the
+            # claimant alone — else one re-routed batch counts its
+            # recovery twice
+            claimed = (work.owner is replica
+                       and not work.done.is_set())
+            if claimed:
+                work.owner = None
+            replica.consecutive_failures = 0
+            replica.probing = False
+            if self.probation_s is not None:
+                replica.probation_backoff_s = self.probation_s
+            if replica.quarantined:
+                # circuit closes: probe success, or a watchdog-flagged
+                # dispatch that eventually completed
+                replica.quarantined = False
+                replica.hung = False
+                rejoined = True
+        if rejoined:
+            _metrics().reintegrated.inc()
+            _log.info(
+                "replica %d (%s) reintegrated after successful probe; "
+                "%d healthy replica(s)",
+                replica.index, replica.device,
+                sum(not r.quarantined for r in self.replicas),
+            )
+        if claimed and work.retries:
+            record_retry("replica.execute", "recovered")
+
+    def _on_failure(self, replica: _Replica, work: _Work,
+                    exc: Exception) -> None:
+        now = time.monotonic()
+        quarantined_now = False
+        with self._lock:
+            # resolution claim: the watchdog may have already taken this
+            # work away (owner cleared / re-routed elsewhere) — then this
+            # failure only feeds the replica accounting below, and the
+            # retries/fail decision belongs to the claimant alone
+            claimed = (work.owner is replica
+                       and not work.done.is_set())
+            if claimed:
+                work.owner = None
+            if replica.hung:
+                # the wedged dispatch finally resolved — with an error,
+                # but the worker thread is free again: lift the
+                # hung-freeze so probation probes can reach the replica
+                # (only _on_success closes the circuit entirely)
+                replica.hung = False
+                if self.probation_s is not None:
+                    replica.probation_until = (
+                        now + replica.probation_backoff_s)
+            was_probe = work.probe and replica.quarantined
+            replica.probing = False
+            if was_probe:
+                # failed probe: stay quarantined, back off exponentially
+                replica.probation_backoff_s = min(
+                    replica.probation_backoff_s * 2.0,
+                    self.probation_max_s,
+                )
+                replica.probation_until = now + replica.probation_backoff_s
+                _log.warning(
+                    "replica %d probation probe failed; next probe in "
+                    "%.2fs", replica.index, replica.probation_backoff_s,
+                )
+            else:
+                replica.consecutive_failures += 1
+                if (replica.consecutive_failures >= self.max_failures
+                        and not replica.quarantined):
+                    replica.quarantined = True
+                    if self.probation_s is not None:
+                        replica.probation_backoff_s = self.probation_s
+                        replica.probation_until = now + self.probation_s
+                    quarantined_now = True
+        if quarantined_now:
+            _metrics().quarantined.inc()
             _log.error(
                 "replica %d (%s) quarantined after %d consecutive "
-                "failures; pool continues on %d healthy replica(s)",
+                "failures; pool continues on %d healthy replica(s)%s",
                 replica.index, replica.device,
                 replica.consecutive_failures,
                 sum(not r.quarantined for r in self.replicas),
+                ("" if self.probation_s is None
+                 else f"; probation probe in {self.probation_s:.2f}s"),
             )
             # re-route work it already accepted: those batches deserve a
             # healthy executor, not a seat behind a broken one
-            requeued = 0
-            while True:
-                try:
-                    work = replica.queue.get_nowait()
-                except queue_mod.Empty:
-                    break
-                if work is None:
-                    replica.queue.put(None)  # keep the shutdown token
-                    break
+            self._requeue_queued(replica)
+        if claimed:
+            self._retry_or_fail(work, exc, exclude=replica)
+
+    def _retry_or_fail(self, work: _Work, exc: Exception,
+                       exclude: "_Replica | None") -> None:
+        """Rider protection: re-route a failed batch up to
+        ``max_reroutes`` times before its error reaches the caller.
+
+        Single-claimant: callers must first take the resolution claim
+        (clear ``work.owner`` under the pool lock while it still points
+        at their replica) — that is what keeps the watchdog and a late
+        worker failure from racing on ``retries``/``fail`` for the same
+        work."""
+        if work.done.is_set():
+            return  # already resolved
+        if not work.reroutable:
+            work.fail(exc)  # replica-pinned (warmup): its error surfaces
+            return
+        was_probe = work.probe
+        if work.retries < self.max_reroutes:
+            work.retries += 1
+            work.probe = False
+            record_retry("replica.execute", "retried")
+            try:
+                self._route(work, exclude=exclude)
+                return
+            except Exception:
+                pass  # no alternative replica: surface the real error
+        if self.max_reroutes:
+            record_retry("replica.execute", "exhausted")
+        if was_probe:
+            # a failed last-ditch probe (no healthy re-route target):
+            # the rider gets the same typed error it would have seen had
+            # the probe never been attempted, with the executor's real
+            # failure chained for diagnosis
+            pool_err = AllReplicasQuarantinedError(
+                f"all {len(self.replicas)} replicas quarantined; the "
+                "probation probe this batch rode also failed"
+            )
+            pool_err.__cause__ = exc
+            work.fail(pool_err)
+            return
+        work.fail(exc)
+
+    def _requeue_queued(self, replica: _Replica) -> None:
+        """Drain a quarantined/hung replica's queue back through
+        routing (its own shutdown token is preserved)."""
+        requeued = 0
+        while True:
+            try:
+                work = replica.queue.get_nowait()
+            except queue_mod.Empty:
+                break
+            if work is None:
+                replica.queue.put(None)  # keep the shutdown token
+                break
+            with self._lock:
+                replica.outstanding -= 1
+            try:
+                self._route(work)
+                requeued += 1
+            except Exception as e:
+                work.fail(e)
+        if requeued:
+            _log.warning(
+                "re-routed %d queued batch(es) off replica %d",
+                requeued, replica.index,
+            )
+
+    # -- hung-dispatch watchdog ----------------------------------------------
+    def _watchdog_loop(self) -> None:
+        assert self.dispatch_timeout_s is not None
+        interval = max(0.005, min(0.25, self.dispatch_timeout_s / 4.0))
+        while not self._closing.wait(interval):
+            now = time.monotonic()
+            for r in self.replicas:
+                work = r.current_work
+                if work is None or work.done.is_set():
+                    continue
+                t0 = work.started_at
+                if t0 is None or now - t0 <= self.dispatch_timeout_s:
+                    continue
+                already = False
                 with self._lock:
-                    replica.outstanding -= 1
-                try:
-                    self._route(work)
-                    requeued += 1
-                except Exception as e:
-                    work.exc = e
-                    work.done.set()
-            if requeued:
-                _log.warning(
-                    "re-routed %d queued batch(es) off quarantined "
-                    "replica %d", requeued, replica.index,
+                    # re-verify under the lock: the worker clears
+                    # current_work BEFORE its success/failure accounting,
+                    # so a dispatch that completed since the unlocked
+                    # read above is visible here — marking it hung would
+                    # quarantine a healthy replica with no completion
+                    # left to ever clear the flag
+                    if r.current_work is not work or work.done.is_set():
+                        continue
+                    # resolution claim (same protocol as _on_failure):
+                    # the wedged worker's current_work stays pointed at
+                    # this work until its thread unwedges, so without
+                    # the claim every later tick would re-fire on the
+                    # stale reference and fail a batch that a previous
+                    # tick already re-routed to a healthy replica
+                    if work.owner is not r:
+                        continue
+                    work.owner = None
+                    already = r.quarantined
+                    r.quarantined = True
+                    r.hung = True
+                    r.probing = False
+                _metrics().hung.inc()
+                if not already:
+                    _metrics().quarantined.inc()
+                _log.error(
+                    "watchdog: dispatch on replica %d exceeded %.2fs; "
+                    "re-routing the batch and quarantining the replica "
+                    "as hung (it rejoins if the wedged program "
+                    "completes)", r.index, self.dispatch_timeout_s,
                 )
+                # rider protection applies to watchdogged work too: the
+                # same re-route-once that covers executor errors (the
+                # wedged executor's late completion is first-writer-wins
+                # discarded by _Work's idempotent resolution)
+                self._retry_or_fail(work, HungDispatchError(
+                    f"dispatch on replica {r.index} exceeded the "
+                    f"{self.dispatch_timeout_s}s deadline"
+                ), exclude=r)
+                self._requeue_queued(r)
 
     # -- lifecycle / introspection -------------------------------------------
     def close(self, *, drain: bool = True,
@@ -330,6 +680,7 @@ class ReplicaPool:
             if self._closed:
                 return
             self._closed = True
+        self._closing.set()
         for r in self.replicas:
             if not drain:
                 while True:
@@ -338,14 +689,15 @@ class ReplicaPool:
                     except queue_mod.Empty:
                         break
                     if work is not None:
-                        work.exc = RuntimeError("ReplicaPool closed")
-                        work.done.set()
+                        work.fail(RuntimeError("ReplicaPool closed"))
             r.queue.put(None)  # wake + stop the worker after the drain
         for r in self.replicas:
             r.thread.join(timeout_s)
             if r.thread.is_alive():  # pragma: no cover - watchdog only
                 _log.warning("replica %d did not stop in %ss",
                              r.index, timeout_s)
+        if self._watchdog is not None:
+            self._watchdog.join(timeout_s)
 
     def warmup(self, arrays: dict[str, np.ndarray]) -> None:
         """Dispatch ``arrays`` to EVERY replica (compile its buckets)
@@ -356,6 +708,8 @@ class ReplicaPool:
         futs = []
         for r in self.replicas:
             work = _Work(arrays)
+            work.reroutable = False  # a failed warmup must SURFACE
+            work.owner = r
             with self._lock:
                 if self._closed:
                     # a closed replica's worker has consumed its shutdown
@@ -369,7 +723,8 @@ class ReplicaPool:
 
     def snapshot(self) -> dict[str, Any]:
         """Operator view: per-replica depth, in-flight, totals,
-        quarantine state, latency percentiles."""
+        quarantine/probation state, latency percentiles."""
+        now = time.monotonic()
         with self._lock:
             replicas = [
                 {
@@ -380,6 +735,13 @@ class ReplicaPool:
                     "dispatched": r.dispatched,
                     "consecutive_failures": r.consecutive_failures,
                     "quarantined": r.quarantined,
+                    "hung": r.hung,
+                    "probing": r.probing,
+                    "next_probe_in_s": (
+                        max(0.0, r.probation_until - now)
+                        if r.quarantined and not r.hung
+                        and self.probation_s is not None else None
+                    ),
                     "latency_s": r.latency.step_time_percentiles((50, 95)),
                 }
                 for r in self.replicas

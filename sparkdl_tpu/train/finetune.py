@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.observability.tracing import span
+from sparkdl_tpu.reliability.faults import fault_point
 from sparkdl_tpu.runtime.completion import AsyncFetcher
 from sparkdl_tpu.runtime.dispatch import (
     ChainPolicy,
@@ -239,6 +240,7 @@ def finetune_classifier(
 
             def run_single(batch: dict) -> None:
                 nonlocal state, host_step
+                fault_point("dispatch")
                 n_examples = len(next(iter(batch.values())))
                 with span("train.step", step=host_step,
                           examples=n_examples):
@@ -269,6 +271,7 @@ def finetune_classifier(
                 # with the TrainState donated; per-step metrics come back
                 # stacked so the recorded trajectory stays exact.
                 nonlocal state, host_step
+                fault_point("dispatch")
                 k = len(group)
                 n_examples = len(next(iter(group[0].values())))
                 with span("dispatch.chain", path="train", k=k,
@@ -295,31 +298,45 @@ def finetune_classifier(
 
             pending: "list[dict]" = []
             pending_key = None
-            for i, batch in enumerate(batches):
-                if i < resume_step:  # deterministic iterator replay on resume
-                    continue
-                if chained_step is None:
-                    run_single(batch)
-                    continue
-                key = shape_key(batch)
-                if pending and key != pending_key:
-                    # ragged boundary (epoch-tail batch): the scan can't
-                    # stack mixed shapes — flush unchained
-                    for b in pending:
-                        run_single(b)
-                    pending = []
-                pending.append(batch)
-                pending_key = key
-                k_target = (chain_steps if chain_steps is not None
-                            else policy.chain_len())
-                if len(pending) >= k_target:
-                    if len(pending) > 1:
-                        run_chain(pending)
-                    else:
-                        run_single(pending[0])
-                    pending = []
-            for b in pending:  # stream tail: no one-off-K compile
-                run_single(b)
+            try:
+                for i, batch in enumerate(batches):
+                    if i < resume_step:  # deterministic replay on resume
+                        continue
+                    if chained_step is None:
+                        run_single(batch)
+                        continue
+                    key = shape_key(batch)
+                    if pending and key != pending_key:
+                        # ragged boundary (epoch-tail batch): the scan
+                        # can't stack mixed shapes — flush unchained
+                        for b in pending:
+                            run_single(b)
+                        pending = []
+                    pending.append(batch)
+                    pending_key = key
+                    k_target = (chain_steps if chain_steps is not None
+                                else policy.chain_len())
+                    if len(pending) >= k_target:
+                        if len(pending) > 1:
+                            run_chain(pending)
+                        else:
+                            run_single(pending[0])
+                        pending = []
+                for b in pending:  # stream tail: no one-off-K compile
+                    run_single(b)
+            except BaseException:
+                # A crashed step must not strand the metrics of steps
+                # whose dispatches already LANDED: a checkpoint may cover
+                # those steps, so a resume will never re-run them — the
+                # crash-time drain is what keeps the recovered history
+                # (reliability/supervisor.py) bitwise-complete. Best
+                # effort: if the device itself died, the drain fails too
+                # and those steps are re-run from the checkpoint anyway.
+                try:
+                    collect(0)
+                except BaseException:
+                    pass
+                raise
             collect(0)  # drain the async metric window: history complete
             if (
                 ckpt is not None

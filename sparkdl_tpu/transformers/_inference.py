@@ -20,6 +20,7 @@ import jax
 import numpy as np
 
 from sparkdl_tpu.observability.tracing import span
+from sparkdl_tpu.reliability.faults import fault_point
 from sparkdl_tpu.runtime.batching import (
     default_buckets,
     pad_to_bucket,
@@ -324,6 +325,7 @@ class BatchedRunner:
         the NEXT micro-batch while the previous one's readback lands.
         Dispatch/occupancy semantics are identical to :meth:`run_batch`
         (one request group = one dispatch, never chained)."""
+        fault_point("dispatch")
         padded = pad_to_bucket(arrays, self._buckets)
         t0 = time.perf_counter()
         with span("serving.device_step", rows=padded.n_valid,

@@ -140,3 +140,308 @@ class TestFinetuneResume:
             ),
             got_params, ref_params,
         )
+
+
+class TestIntegrity:
+    """Reliability layer: per-save digests in the sidecar manifest, and
+    restore falling back past a torn/corrupt newest step (previously a
+    single bad file poisoned every future restore)."""
+
+    def _corrupt_step(self, directory, step):
+        """Garble the largest file in the step dir (a torn write)."""
+        import os
+
+        step_dir = os.path.join(directory, str(step))
+        victim, size = None, -1
+        for root, _, files in os.walk(step_dir):
+            for f in files:
+                p = os.path.join(root, f)
+                if os.path.getsize(p) > size:
+                    victim, size = p, os.path.getsize(p)
+        assert victim is not None
+        with open(victim, "r+b") as f:
+            f.truncate(max(0, size // 2))
+            f.seek(0)
+            f.write(b"\xde\xad\xbe\xef")
+        return victim
+
+    def test_digest_manifest_written_and_verifies(
+        self, tmp_path, eight_device_mesh
+    ):
+        import json
+        import os
+
+        state = _state(eight_device_mesh)
+        with CheckpointManager(tmp_path / "c") as mgr:
+            mgr.save(1, state, force=True)
+            mgr.wait()
+            assert mgr.verify(1) is True
+        manifest = json.load(
+            open(os.path.join(tmp_path / "c", "sparkdl_integrity.json"))
+        )
+        assert "1" in manifest and "sha256" in manifest["1"]
+
+    def test_restore_falls_back_to_newest_intact_step(
+        self, tmp_path, eight_device_mesh
+    ):
+        from sparkdl_tpu.observability.registry import registry
+
+        mesh = eight_device_mesh
+        directory = tmp_path / "c"
+        with CheckpointManager(directory) as mgr:
+            mgr.save(1, _state(mesh, scale=1.0), force=True)
+            mgr.save(2, _state(mesh, scale=2.0), force=True)
+            mgr.wait()
+            self._corrupt_step(str(directory), 2)
+            assert mgr.verify(2) is False
+            fallbacks0 = registry().get(
+                "sparkdl_checkpoint_fallbacks_total").snapshot_values().get(
+                    "", 0.0)
+            restored = mgr.restore(template=_state(mesh, scale=0.0))
+            # newest intact step is 1 — scale 1.0 values
+            np.testing.assert_array_equal(
+                np.asarray(restored["params"]["w"]),
+                np.asarray(_state(mesh, scale=1.0)["params"]["w"]),
+            )
+            assert registry().get(
+                "sparkdl_checkpoint_fallbacks_total").snapshot_values()[
+                    ""] == fallbacks0 + 1
+
+    def test_explicitly_pinned_corrupt_step_raises(
+        self, tmp_path, eight_device_mesh
+    ):
+        from sparkdl_tpu.checkpoint import CheckpointCorruptError
+
+        mesh = eight_device_mesh
+        with CheckpointManager(tmp_path / "c") as mgr:
+            mgr.save(3, _state(mesh), force=True)
+            mgr.wait()
+            self._corrupt_step(str(tmp_path / "c"), 3)
+            with pytest.raises(CheckpointCorruptError):
+                mgr.restore(3, template=_state(mesh, scale=0.0))
+
+    def test_all_steps_corrupt_raises_corrupt_error(
+        self, tmp_path, eight_device_mesh
+    ):
+        from sparkdl_tpu.checkpoint import CheckpointCorruptError
+
+        mesh = eight_device_mesh
+        with CheckpointManager(tmp_path / "c") as mgr:
+            mgr.save(1, _state(mesh), force=True)
+            mgr.wait()
+            self._corrupt_step(str(tmp_path / "c"), 1)
+            with pytest.raises(CheckpointCorruptError):
+                mgr.restore(template=_state(mesh, scale=0.0))
+
+    def test_pre_manifest_missing_file_falls_back(
+        self, tmp_path, eight_device_mesh
+    ):
+        """A checkpoint written before the integrity manifest existed
+        (verify() -> None) that then LOST a file on disk must take the
+        same fallback path as a digest mismatch — not propagate the
+        reader's FileNotFoundError and poison the restore."""
+        import os
+
+        mesh = eight_device_mesh
+        directory = tmp_path / "c"
+        with CheckpointManager(directory, verify_integrity=False) as mgr:
+            mgr.save(1, _state(mesh, scale=1.0), force=True)
+            mgr.save(2, _state(mesh, scale=2.0), force=True)
+            mgr.wait()
+        # recycled-disk loss: the newest step's payload vanishes but its
+        # step-level marker survives, so the step is still listed
+        step_dir = os.path.join(directory, "2")
+        removed = 0
+        for root, _, files in os.walk(step_dir):
+            for f in files:
+                p = os.path.join(root, f)
+                if os.path.basename(p) != "_CHECKPOINT_METADATA":
+                    os.remove(p)
+                    removed += 1
+        assert removed > 0
+        with CheckpointManager(directory) as mgr:
+            restored = mgr.restore(template=_state(mesh, scale=0.0))
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["w"]),
+            np.asarray(_state(mesh, scale=1.0)["params"]["w"]),
+        )
+
+    def test_intact_step_restore_failure_propagates(
+        self, tmp_path, eight_device_mesh, monkeypatch
+    ):
+        """A restore failure on a step the manifest verifies as INTACT
+        is not corruption (template mismatch, transient device error):
+        it must propagate as itself — silently falling back would
+        resume from the wrong step."""
+        mesh = eight_device_mesh
+        with CheckpointManager(tmp_path / "c") as mgr:
+            mgr.save(1, _state(mesh, scale=1.0), force=True)
+            mgr.save(2, _state(mesh, scale=2.0), force=True)
+            mgr.wait()
+
+            def flaky(step, template):
+                raise RuntimeError("transient device error")
+
+            monkeypatch.setattr(mgr, "_do_restore", flaky)
+            # both steps verify intact: the failure is NOT corruption —
+            # no fallback to step 1, no CheckpointCorruptError mask
+            with pytest.raises(RuntimeError, match="transient"):
+                mgr.restore(template=_state(mesh, scale=0.0))
+
+    def test_integrity_disabled_keeps_simple_restore(
+        self, tmp_path, eight_device_mesh, monkeypatch
+    ):
+        """verify_integrity=False restores exactly the pre-integrity
+        way: ONE restore of the chosen step, any error propagating as
+        itself — no fallback loop, no CheckpointCorruptError mask."""
+        mesh = eight_device_mesh
+        with CheckpointManager(tmp_path / "c",
+                               verify_integrity=False) as mgr:
+            mgr.save(1, _state(mesh, scale=1.0), force=True)
+            mgr.save(2, _state(mesh, scale=2.0), force=True)
+            mgr.wait()
+            calls = []
+
+            def flaky(step, template):
+                calls.append(step)
+                raise RuntimeError("not corruption")
+
+            monkeypatch.setattr(mgr, "_do_restore", flaky)
+            with pytest.raises(RuntimeError, match="not corruption"):
+                mgr.restore(template=_state(mesh, scale=0.0))
+            assert calls == [2]  # newest only; no fallback attempted
+
+    def test_bad_template_never_quarantines_pre_manifest_history(
+        self, tmp_path, eight_device_mesh, monkeypatch
+    ):
+        """Pre-manifest steps (verify() -> None) that fail to restore
+        are only quarantined once an OLDER step proves the template
+        good. When every candidate fails identically — the signature of
+        a caller-side template mismatch — no dir may be renamed: one
+        user error must not destroy intact checkpoint history."""
+        from sparkdl_tpu.checkpoint import CheckpointCorruptError
+
+        mesh = eight_device_mesh
+        directory = tmp_path / "c"
+        with CheckpointManager(directory, verify_integrity=False) as mgr:
+            mgr.save(1, _state(mesh, scale=1.0), force=True)
+            mgr.save(2, _state(mesh, scale=2.0), force=True)
+            mgr.wait()
+        with CheckpointManager(directory) as mgr:
+            real = mgr._do_restore
+
+            def bad_template(step, template):
+                raise ValueError("template shape/sharding mismatch")
+
+            monkeypatch.setattr(mgr, "_do_restore", bad_template)
+            with pytest.raises(CheckpointCorruptError):
+                mgr.restore(template=_state(mesh, scale=0.0))
+            # deferred quarantine: nothing restored, so nothing renamed
+            assert mgr.all_steps() == [1, 2]
+            # the corrected retry still sees the full intact history
+            monkeypatch.setattr(mgr, "_do_restore", real)
+            restored = mgr.restore(template=_state(mesh, scale=0.0))
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["w"]),
+            np.asarray(_state(mesh, scale=2.0)["params"]["w"]),
+        )
+
+    def test_no_verdict_failure_quarantined_after_older_restores(
+        self, tmp_path, eight_device_mesh
+    ):
+        """The flip side of deferred quarantine: once an older step
+        restores (proving the template good), a newer no-verdict step
+        that failed really was unreadable — its dir is renamed out of
+        the step namespace and the corruption counter ticks."""
+        import os
+
+        from sparkdl_tpu.observability.registry import registry
+
+        mesh = eight_device_mesh
+        directory = tmp_path / "c"
+        with CheckpointManager(directory, verify_integrity=False) as mgr:
+            mgr.save(1, _state(mesh, scale=1.0), force=True)
+            mgr.save(2, _state(mesh, scale=2.0), force=True)
+            mgr.wait()
+        step_dir = os.path.join(directory, "2")
+        for root, _, files in os.walk(step_dir):
+            for f in files:
+                if f != "_CHECKPOINT_METADATA":
+                    os.remove(os.path.join(root, f))
+        corrupt0 = registry().get(
+            "sparkdl_checkpoint_corrupt_total").snapshot_values().get(
+                "", 0.0)
+        with CheckpointManager(directory) as mgr:
+            restored = mgr.restore(template=_state(mesh, scale=0.0))
+            assert mgr.all_steps() == [1]  # step 2 renamed away
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["w"]),
+            np.asarray(_state(mesh, scale=1.0)["params"]["w"]),
+        )
+        assert os.path.isdir(os.path.join(directory, "corrupt-step-2"))
+        assert registry().get(
+            "sparkdl_checkpoint_corrupt_total").snapshot_values()[
+                ""] == corrupt0 + 1
+
+    def test_restore_hashes_fresh_save_once(
+        self, tmp_path, eight_device_mesh, monkeypatch
+    ):
+        """restore() right after save() verifies the candidate with the
+        digest its own finalize barrier just computed — each step dir is
+        hashed once, not once in _finalize_digests and again in
+        verify() (checkpoints can be multi-GB)."""
+        import sparkdl_tpu.checkpoint.manager as manager_mod
+
+        calls = []
+        real = manager_mod.checkpoint_digest
+
+        def counting(step_dir):
+            calls.append(step_dir)
+            return real(step_dir)
+
+        monkeypatch.setattr(manager_mod, "checkpoint_digest", counting)
+        mesh = eight_device_mesh
+        with CheckpointManager(tmp_path / "c") as mgr:
+            mgr.save(1, _state(mesh, scale=1.0), force=True)
+            mgr.restore(template=_state(mesh, scale=0.0))
+        assert len(calls) == len(set(calls)), (
+            f"step dir hashed more than once: {calls}")
+
+    def test_gcd_steps_pruned_from_manifest(
+        self, tmp_path, eight_device_mesh
+    ):
+        import json
+        import os
+
+        mesh = eight_device_mesh
+        with CheckpointManager(tmp_path / "c", keep=2) as mgr:
+            for s in (1, 2, 3, 4):
+                mgr.save(s, _state(mesh), force=True)
+                mgr.wait()
+        manifest = json.load(
+            open(os.path.join(tmp_path / "c", "sparkdl_integrity.json"))
+        )
+        assert set(manifest) <= {"3", "4"}  # GC'd steps pruned
+
+    def test_verify_unknown_step_is_none(self, tmp_path, eight_device_mesh):
+        with CheckpointManager(tmp_path / "c") as mgr:
+            mgr.save(1, _state(eight_device_mesh), force=True)
+            mgr.wait()
+            assert mgr.verify(99) is None  # no digest recorded: unknown
+
+    def test_save_retries_transient_faults(
+        self, tmp_path, eight_device_mesh
+    ):
+        """An injected checkpoint.save fault is retried and the save
+        still lands (retry wiring, fault site, and metrics together)."""
+        from sparkdl_tpu.reliability.faults import inject
+        from sparkdl_tpu.reliability.retry import RetryBudget, RetryPolicy
+
+        retry = RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                            sleep=lambda s: None, budget=RetryBudget(10))
+        with CheckpointManager(tmp_path / "c", retry=retry) as mgr:
+            with inject("checkpoint.save:OSError@1"):
+                assert mgr.save(1, _state(eight_device_mesh), force=True)
+            mgr.wait()
+            assert mgr.latest_step() == 1
+            assert mgr.verify(1) is True

@@ -45,6 +45,25 @@ class TestFamilies:
         with pytest.raises(ValueError, match="use .labels"):
             c.inc()  # labeled family needs its labels
 
+    def test_labelled_values_structured_access(self):
+        r = MetricsRegistry()
+        c = r.counter("shed_total", labels=("reason", "site"))
+        c.inc(reason="expired", site="q")
+        c.inc(3, reason="closed", site="q")
+        # keyed by ONE label's raw value — no parsing of rendered
+        # 'reason="..."' strings
+        assert r.get("shed_total").labelled_values("reason") == {
+            "expired": 1.0, "closed": 3.0,
+        }
+        # series colliding on the chosen dimension are SUMMED (here:
+        # reason="expired" across two sites), never silently last-wins
+        c.inc(5, reason="expired", site="other")
+        assert r.get("shed_total").labelled_values("reason") == {
+            "expired": 6.0, "closed": 3.0,
+        }
+        with pytest.raises(ValueError):
+            r.get("shed_total").labelled_values("nope")
+
     def test_redeclaration_must_agree(self):
         r = MetricsRegistry()
         c1 = r.counter("n_total", "first help")

@@ -1,0 +1,127 @@
+"""RetryPolicy: bounded attempts, full-jitter backoff, classification,
+budget, and spine metrics."""
+
+import pytest
+
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.reliability.retry import (
+    RetryBudget,
+    RetryExhaustedError,
+    RetryPolicy,
+)
+
+
+def _policy(**kw):
+    kw.setdefault("base_delay_s", 0.01)
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("budget", RetryBudget(1000))  # isolate from process pool
+    kw.setdefault("seed", 0)
+    return RetryPolicy(**kw)
+
+
+def _retry_count(site, outcome):
+    fam = registry().get("sparkdl_retries_total")
+    if fam is None:
+        return 0.0
+    return fam.snapshot_values().get(
+        f'site="{site}",outcome="{outcome}"', 0.0)
+
+
+class _Flaky:
+    def __init__(self, fail_times, exc=RuntimeError("transient")):
+        self.fail_times = fail_times
+        self.exc = exc
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise self.exc
+        return "ok"
+
+
+def test_recovers_after_transient_failures():
+    fn = _Flaky(2)
+    before = _retry_count("t1", "recovered")
+    assert _policy(max_attempts=3).call(fn, site="t1") == "ok"
+    assert fn.calls == 3
+    assert _retry_count("t1", "recovered") == before + 1
+
+
+def test_exhausted_raises_with_cause():
+    fn = _Flaky(99)
+    with pytest.raises(RetryExhaustedError) as ei:
+        _policy(max_attempts=3).call(fn, site="t2")
+    assert fn.calls == 3
+    assert isinstance(ei.value.__cause__, RuntimeError)
+    assert _retry_count("t2", "exhausted") >= 1
+
+
+def test_fatal_propagates_immediately():
+    fn = _Flaky(99, exc=TypeError("bug"))
+    with pytest.raises(TypeError):
+        _policy(max_attempts=5, fatal=(TypeError,)).call(fn, site="t3")
+    assert fn.calls == 1
+    assert _retry_count("t3", "fatal") >= 1
+
+
+def test_unclassified_exception_propagates_untouched():
+    class Weird(BaseException):
+        pass
+
+    fn = _Flaky(99, exc=Weird())
+    with pytest.raises(Weird):
+        _policy(max_attempts=5).call(fn)  # Weird is not an Exception
+    assert fn.calls == 1
+
+
+def test_backoff_is_full_jitter_and_capped():
+    delays = []
+    pol = _policy(max_attempts=6, base_delay_s=1.0, max_delay_s=3.0,
+                  sleep=delays.append)
+    with pytest.raises(RetryExhaustedError):
+        pol.call(_Flaky(99))
+    assert len(delays) == 5
+    # attempt n's ceiling: min(3.0, 1.0 * 2**(n-1)); full jitter draws
+    # uniformly below it
+    for i, d in enumerate(delays, start=1):
+        assert 0.0 <= d <= min(3.0, 2.0 ** (i - 1))
+    # deterministic under a pinned seed
+    delays2 = []
+    pol2 = _policy(max_attempts=6, base_delay_s=1.0, max_delay_s=3.0,
+                   sleep=delays2.append)
+    with pytest.raises(RetryExhaustedError):
+        pol2.call(_Flaky(99))
+    assert delays == delays2
+
+
+def test_budget_stops_retries():
+    budget = RetryBudget(1)
+    fn = _Flaky(99)
+    with pytest.raises(RetryExhaustedError, match="budget"):
+        _policy(max_attempts=10, budget=budget).call(fn, site="t4")
+    assert fn.calls == 2  # one retry allowed, then the budget said no
+    assert budget.remaining == 0
+    assert _retry_count("t4", "budget") >= 1
+
+
+def test_budget_reset_refills():
+    b = RetryBudget(2)
+    assert b.try_acquire() and b.try_acquire() and not b.try_acquire()
+    b.reset()
+    assert b.remaining == 2
+    b.reset(5)
+    assert b.remaining == 5
+
+
+def test_success_on_first_attempt_records_nothing():
+    before = _retry_count("t5", "recovered")
+    assert _policy().call(lambda: 7, site="t5") == 7
+    assert _retry_count("t5", "recovered") == before
+
+
+def test_bad_config_rejected():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        RetryBudget(-1)

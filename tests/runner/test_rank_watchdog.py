@@ -1,0 +1,59 @@
+"""Rank watchdog (`LocalProcessBackend(straggler_grace_s=...)`): once
+the first rank exits cleanly, survivors past the grace window are torn
+down as hung instead of holding the job until the global ``timeout_s``.
+
+Tested at the ``_wait_all`` layer with plain subprocesses — the watchdog
+is pure process supervision, no JAX required."""
+
+import subprocess
+import sys
+import time
+
+from sparkdl_tpu.runner.backends import _wait_all
+
+
+def _proc(code: str) -> subprocess.Popen:
+    return subprocess.Popen([sys.executable, "-c", code])
+
+
+def test_hung_rank_torn_down_after_grace():
+    procs = [
+        _proc("pass"),                        # rank 0 exits immediately
+        _proc("import time; time.sleep(60)"),  # rank 1 wedged
+    ]
+    t0 = time.monotonic()
+    failed = _wait_all(procs, timeout_s=60.0, straggler_grace_s=0.3)
+    elapsed = time.monotonic() - t0
+    assert failed == [1]
+    # the whole point: teardown on the grace window, not timeout_s
+    assert elapsed < 10.0, elapsed
+    procs[1].wait(timeout=5)  # actually killed, not left running
+
+
+def test_disabled_watchdog_waits_for_stragglers():
+    procs = [
+        _proc("pass"),
+        _proc("import time; time.sleep(0.8)"),  # slow but legit
+    ]
+    failed = _wait_all(procs, timeout_s=30.0, straggler_grace_s=None)
+    assert failed == []  # default behavior unchanged: skew tolerated
+
+
+def test_skew_within_grace_is_not_killed():
+    procs = [
+        _proc("pass"),
+        _proc("import time; time.sleep(0.4)"),
+    ]
+    failed = _wait_all(procs, timeout_s=30.0, straggler_grace_s=5.0)
+    assert failed == []
+
+
+def test_failed_rank_still_aborts_job():
+    # the watchdog must not mask the existing first-failure abort
+    procs = [
+        _proc("raise SystemExit(3)"),
+        _proc("import time; time.sleep(60)"),
+    ]
+    failed = _wait_all(procs, timeout_s=60.0, straggler_grace_s=30.0)
+    assert 0 in failed
+    procs[1].wait(timeout=5)  # peers killed on first failure

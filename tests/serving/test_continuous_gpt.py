@@ -121,8 +121,17 @@ def test_eos_frees_slot_early(bundle):
 
 
 def test_deadline_expiry_mid_decode(bundle):
+    from sparkdl_tpu.observability.registry import registry
+
+    def _expired_count():
+        fam = registry().get("sparkdl_requests_failed_total")
+        if fam is None:
+            return 0.0
+        return fam.labelled_values("reason").get("expired", 0.0)
+
     cfg, _, variables = bundle
     eng = _engine(cfg, variables)
+    expired0 = _expired_count()
     fut = eng.submit([1, 2, 3], 20, timeout_s=0.01)
     eng.tick()  # admitted into a slot
     assert eng.active_slots == 1
@@ -132,6 +141,9 @@ def test_deadline_expiry_mid_decode(bundle):
         fut.result(timeout=0)
     assert eng.active_slots == 0
     assert eng.snapshot()["failed"] == 1
+    # a mid-decode expiry is shed load too: it must land in the
+    # registry alongside queue-level expiries
+    assert _expired_count() == expired0 + 1
     eng.close()
 
 

@@ -1,0 +1,433 @@
+"""ReplicaPool reliability: re-route-once rider protection, probation
+probes closing the circuit breaker, and the hung-dispatch watchdog."""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.serving.replicas import (
+    AllReplicasQuarantinedError,
+    HungDispatchError,
+    ReplicaPool,
+)
+from sparkdl_tpu.transformers._inference import BatchedRunner
+
+DIM = 6
+_W = jnp.asarray(
+    np.random.default_rng(5).standard_normal((DIM, DIM)), jnp.float32
+)
+
+
+def _apply(b):
+    return jnp.tanh(b["x"] @ _W)
+
+
+def _batch(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"x": rng.standard_normal((n, DIM)).astype(np.float32)}
+
+
+class _ScriptedRunner:
+    """Runner wrapper whose dispatches fail while ``failing`` is True
+    (and always counts calls)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.failing = False
+        self.calls = 0
+        self.chunk_size = inner.chunk_size
+
+    def run_batch(self, arrays):
+        self.calls += 1
+        if self.failing:
+            raise RuntimeError("scripted executor failure")
+        return self._inner.run_batch(arrays)
+
+
+class _SleepyRunner:
+    """First dispatch hangs for ``hang_s``; later dispatches are fine."""
+
+    def __init__(self, inner, hang_s):
+        self._inner = inner
+        self.hang_s = hang_s
+        self.calls = 0
+        self.chunk_size = inner.chunk_size
+
+    def run_batch(self, arrays):
+        self.calls += 1
+        if self.calls == 1:
+            time.sleep(self.hang_s)
+        return self._inner.run_batch(arrays)
+
+
+def _scripted_pool(n=2, **kw):
+    runners = []
+
+    def make_runner(device):
+        r = _ScriptedRunner(
+            BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                          device=device)
+        )
+        runners.append(r)
+        return r
+
+    kw.setdefault("max_failures", 2)
+    pool = ReplicaPool(make_runner=make_runner, n_replicas=n, **kw)
+    return pool, runners
+
+
+def _counter(name, **labels):
+    fam = registry().get(name)
+    if fam is None:
+        return 0.0
+    key = ",".join(f'{k}="{v}"' for k, v in labels.items())
+    return fam.snapshot_values().get(key, 0.0)
+
+
+def test_single_failure_is_rerouted_not_surfaced():
+    pool, runners = _scripted_pool(probation_s=600.0)
+    try:
+        runners[0].failing = True  # replica 0 fails everything
+        retried0 = _counter("sparkdl_retries_total",
+                            site="replica.execute", outcome="retried")
+        single = BatchedRunner(_apply, batch_size=8, data_parallel=False)
+        for i in range(8):
+            np.testing.assert_array_equal(
+                pool.run_batch(_batch(4, seed=i)),
+                single.run_batch(_batch(4, seed=i)),
+            )
+        assert _counter("sparkdl_retries_total",
+                        site="replica.execute",
+                        outcome="retried") > retried0
+        # circuit opened after max_failures, but no caller ever saw it
+        assert pool.snapshot()["replicas"][0]["quarantined"]
+    finally:
+        pool.close()
+
+
+def test_probation_probe_reintegrates_replica():
+    pool, runners = _scripted_pool(probation_s=0.05, probation_max_s=1.0)
+    try:
+        runners[0].failing = True
+        for i in range(4):  # open replica 0's circuit
+            pool.run_batch(_batch(4, seed=i))
+        assert pool.snapshot()["healthy_count"] == 1
+        runners[0].failing = False  # the "restart" — replica is well again
+        reintegrated0 = _counter("sparkdl_replica_reintegrated_total")
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            pool.run_batch(_batch(4, seed=99))  # traffic carries the probe
+            if pool.snapshot()["healthy_count"] == 2:
+                break
+            time.sleep(0.02)
+        snap = pool.snapshot()
+        assert snap["healthy_count"] == 2, snap
+        assert not snap["replicas"][0]["quarantined"]
+        assert _counter(
+            "sparkdl_replica_reintegrated_total") == reintegrated0 + 1
+        # and the reintegrated replica takes real work again
+        before = runners[0].calls
+        for i in range(8):
+            pool.run_batch(_batch(4, seed=i))
+        assert runners[0].calls > before
+    finally:
+        pool.close()
+
+
+def test_failed_probe_backs_off_exponentially():
+    pool, runners = _scripted_pool(probation_s=0.05, probation_max_s=10.0)
+    try:
+        runners[0].failing = True  # fails forever, probes included
+        for i in range(4):
+            pool.run_batch(_batch(4, seed=i))
+        assert pool.snapshot()["replicas"][0]["quarantined"]
+        # drive enough traffic over >2 backoff windows for several probes
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            pool.run_batch(_batch(2, seed=7))
+            time.sleep(0.01)
+        snap = pool.snapshot()["replicas"][0]
+        assert snap["quarantined"]  # never rejoined
+        # backoff doubled at least once: next probe scheduled further out
+        # than the base probation window
+        assert snap["next_probe_in_s"] is None or \
+            pool.replicas[0].probation_backoff_s > 0.05
+    finally:
+        pool.close()
+
+
+def test_all_quarantined_recovers_via_probe():
+    """Even a fully-quarantined pool self-heals: the next submit after a
+    probation window routes as a probe instead of raising."""
+    pool, runners = _scripted_pool(probation_s=0.05, max_failures=1,
+                                   n=2)
+    try:
+        for r in runners:
+            r.failing = True
+        with pytest.raises(RuntimeError):
+            pool.run_batch(_batch(2))  # opens both circuits (re-route burns 2nd)
+        with pytest.raises(AllReplicasQuarantinedError):
+            pool.run_batch(_batch(2))
+        for r in runners:
+            r.failing = False
+        time.sleep(0.08)  # probation due
+        out = pool.run_batch(_batch(3, seed=1))  # served as a probe
+        single = BatchedRunner(_apply, batch_size=8, data_parallel=False)
+        np.testing.assert_array_equal(
+            out, single.run_batch(_batch(3, seed=1)))
+        assert pool.snapshot()["healthy_count"] >= 1
+    finally:
+        pool.close()
+
+
+def test_failed_last_ditch_probe_surfaces_typed_error():
+    """All replicas quarantined, a probe is due, and the executor is
+    still broken: the rider gets the same typed
+    AllReplicasQuarantinedError it would have seen had the probe never
+    run — with the executor's real failure chained — not the raw
+    executor exception."""
+    pool, runners = _scripted_pool(probation_s=0.05, max_failures=1, n=2)
+    try:
+        for r in runners:
+            r.failing = True
+        with pytest.raises(RuntimeError):
+            pool.run_batch(_batch(2))  # opens both circuits
+        time.sleep(0.08)  # probation due
+        with pytest.raises(AllReplicasQuarantinedError) as ei:
+            pool.run_batch(_batch(2, seed=1))  # rides a probe; it fails
+        assert isinstance(ei.value.__cause__, RuntimeError)
+        assert "scripted executor failure" in str(ei.value.__cause__)
+    finally:
+        pool.close()
+
+
+def test_probation_none_is_permanent_quarantine():
+    pool, runners = _scripted_pool(probation_s=None, max_failures=1, n=2)
+    try:
+        runners[0].failing = True
+        runners[1].failing = True
+        with pytest.raises(RuntimeError):
+            pool.run_batch(_batch(2))
+        time.sleep(0.05)
+        with pytest.raises(AllReplicasQuarantinedError):
+            pool.run_batch(_batch(2))  # no probes, ever
+    finally:
+        pool.close()
+
+
+def test_hung_dispatch_watchdog_fails_work_and_pool_survives():
+    made = []
+
+    def make_runner(device):
+        inner = BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                              device=device)
+        # only the FIRST replica's first dispatch wedges
+        r = _SleepyRunner(inner, hang_s=1.0 if not made else 0.0)
+        made.append(r)
+        return r
+
+    hung0 = _counter("sparkdl_replica_hung_total")
+    pool = ReplicaPool(make_runner=make_runner, n_replicas=2,
+                       dispatch_timeout_s=0.15, probation_s=600.0,
+                       max_reroutes=0)
+    try:
+        # warmup touches both replicas: replica 0 wedges for 1s; the
+        # watchdog must fail that batch at ~0.15s, not wait out the hang
+        t0 = time.monotonic()
+        with pytest.raises(HungDispatchError):
+            pool.warmup(_batch(8))
+        assert time.monotonic() - t0 < 0.9
+        assert _counter("sparkdl_replica_hung_total") > hung0
+        snap = pool.snapshot()
+        assert snap["replicas"][0]["quarantined"]
+        assert snap["replicas"][0]["hung"]
+        # the pool keeps serving on the healthy replica meanwhile
+        single = BatchedRunner(_apply, batch_size=8, data_parallel=False)
+        np.testing.assert_array_equal(
+            pool.run_batch(_batch(4, seed=1)),
+            single.run_batch(_batch(4, seed=1)))
+        # the wedged program completes eventually and the replica rejoins
+        # through the normal success path
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if pool.snapshot()["healthy_count"] == 2:
+                break
+            time.sleep(0.05)
+        assert pool.snapshot()["healthy_count"] == 2
+        np.testing.assert_array_equal(
+            pool.run_batch(_batch(3, seed=2)),
+            single.run_batch(_batch(3, seed=2)))
+    finally:
+        pool.close()
+
+
+class _SleepyThenFailRunner:
+    """First dispatch hangs for ``hang_s`` then RAISES; later dispatches
+    are fine (the wedged-program-dies-uncleanly drill)."""
+
+    def __init__(self, inner, hang_s):
+        self._inner = inner
+        self.hang_s = hang_s
+        self.calls = 0
+        self.chunk_size = inner.chunk_size
+
+    def run_batch(self, arrays):
+        self.calls += 1
+        if self.calls == 1 and self.hang_s:
+            time.sleep(self.hang_s)
+            raise RuntimeError("wedged program aborted")
+        return self._inner.run_batch(arrays)
+
+
+def test_hung_replica_rejoins_when_wedged_dispatch_errors():
+    """A watchdog-flagged replica whose wedged program finally resolves
+    with an ERROR (not a success) must still exit the hung-freeze and
+    become probe-eligible — quarantine is a circuit breaker even for
+    dispatches that die uncleanly."""
+    made = []
+
+    def make_runner(device):
+        inner = BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                              device=device)
+        r = _SleepyThenFailRunner(inner, hang_s=0.5 if not made else 0.0)
+        made.append(r)
+        return r
+
+    pool = ReplicaPool(make_runner=make_runner, n_replicas=2,
+                       dispatch_timeout_s=0.1, probation_s=0.05,
+                       probation_max_s=1.0)
+    try:
+        with pytest.raises(HungDispatchError):
+            pool.warmup(_batch(8))
+        assert pool.snapshot()["replicas"][0]["hung"]
+        # drive traffic until the wedged program aborts, the hung-freeze
+        # lifts, and a probation probe reintegrates replica 0
+        deadline = time.monotonic() + 10.0
+        while (pool.snapshot()["healthy_count"] < 2
+               and time.monotonic() < deadline):
+            pool.run_batch(_batch(4, seed=3))
+            time.sleep(0.02)
+        snap = pool.snapshot()
+        assert snap["healthy_count"] == 2, snap
+        assert not snap["replicas"][0]["hung"], snap
+    finally:
+        pool.close()
+
+
+def test_hung_dispatch_rerouted_rider_gets_result():
+    """A reroutable batch whose dispatch wedges is re-routed by the
+    watchdog — the rider gets a RESULT from a healthy replica, not a
+    HungDispatchError (same protection as an executor error)."""
+    import threading
+
+    hang = threading.Event()
+    made = []
+
+    def make_runner(device):
+        inner = BatchedRunner(_apply, batch_size=8, data_parallel=False,
+                              device=device)
+
+        class _R:
+            chunk_size = inner.chunk_size
+            sleepy = not made
+
+            def run_batch(self, arrays):
+                if self.sleepy and hang.is_set():
+                    time.sleep(2.0)
+                return inner.run_batch(arrays)
+
+        r = _R()
+        made.append(r)
+        return r
+
+    pool = ReplicaPool(make_runner=make_runner, n_replicas=2,
+                       dispatch_timeout_s=0.15, probation_s=600.0)
+    try:
+        pool.warmup(_batch(8))  # compile both replicas (hang unset)
+        single = BatchedRunner(_apply, batch_size=8, data_parallel=False)
+        recovered0 = _counter("sparkdl_retries_total",
+                              site="replica.execute", outcome="recovered")
+        hang.set()  # replica 0 now wedges every dispatch
+        # two batches: least-work routing spreads them over both
+        # replicas, so one lands on the wedged replica 0
+        futs = [pool.run_batch_async(_batch(4, seed=s)) for s in range(2)]
+        for s, f in enumerate(futs):
+            np.testing.assert_array_equal(
+                f.result(timeout=10),
+                single.run_batch(_batch(4, seed=s)))
+        assert pool.snapshot()["replicas"][0]["hung"]
+        hang.clear()
+        # the wedged dispatch eventually SUCCEEDS (late): it heals the
+        # replica but must NOT double-count the rerouted batch's
+        # recovery — only the claimant records the outcome
+        deadline = time.monotonic() + 10.0
+        while (pool.snapshot()["replicas"][0]["hung"]
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert not pool.snapshot()["replicas"][0]["hung"]
+        assert _counter("sparkdl_retries_total", site="replica.execute",
+                        outcome="recovered") == recovered0 + 1
+    finally:
+        pool.close()
+
+
+def test_no_probe_when_reroutes_disabled():
+    """max_reroutes=0 removes the probe's rider protection, so probes
+    must be disabled too: requests keep routing to healthy replicas and
+    never eat a quarantined replica's error."""
+    pool, runners = _scripted_pool(n=2, max_reroutes=0, probation_s=0.05,
+                                   probation_max_s=0.5)
+    try:
+        runners[0].failing = True  # permanently broken replica
+        deadline = time.monotonic() + 5.0
+        while (not pool.snapshot()["replicas"][0]["quarantined"]
+               and time.monotonic() < deadline):
+            try:  # routing ties round-robin: drive until 0 quarantines
+                pool.run_batch(_batch(4, seed=1))
+            except RuntimeError:
+                pass
+        assert pool.snapshot()["replicas"][0]["quarantined"]
+        calls_at_quarantine = runners[0].calls
+        time.sleep(0.2)  # probation long elapsed
+        single = BatchedRunner(_apply, batch_size=8, data_parallel=False)
+        for seed in range(8):  # no request may be burned as a probe
+            np.testing.assert_array_equal(
+                pool.run_batch(_batch(4, seed=seed)),
+                single.run_batch(_batch(4, seed=seed)))
+        assert runners[0].calls == calls_at_quarantine
+    finally:
+        pool.close()
+
+
+def test_warmup_failure_surfaces_not_rerouted():
+    """warmup() pins one batch to EVERY replica; a replica whose warmup
+    fails must surface the error instead of having its batch silently
+    re-routed to a healthy peer (which would leave an uncompiled — or
+    broken — replica in rotation)."""
+    pool, runners = _scripted_pool(n=2)
+    try:
+        runners[1].failing = True  # replica 1 cannot execute at all
+        with pytest.raises(RuntimeError, match="scripted"):
+            pool.warmup(_batch(8))
+    finally:
+        pool.close()
+
+
+def test_reliability_knob_validation():
+    with pytest.raises(ValueError, match="probation_s"):
+        ReplicaPool(_apply, probation_s=0.0, n_replicas=1)
+    with pytest.raises(ValueError, match="max_reroutes"):
+        ReplicaPool(_apply, max_reroutes=-1, n_replicas=1)
+    with pytest.raises(ValueError, match="dispatch_timeout_s"):
+        ReplicaPool(_apply, dispatch_timeout_s=0.0, n_replicas=1)
+
+
+def test_snapshot_carries_reliability_fields():
+    with ReplicaPool(_apply, batch_size=8, n_replicas=2) as pool:
+        snap = pool.snapshot()
+    r = snap["replicas"][0]
+    assert {"quarantined", "hung", "probing", "next_probe_in_s"} <= set(r)

@@ -122,3 +122,105 @@ def test_fail_pending():
 def test_bad_depth_rejected():
     with pytest.raises(ValueError, match="max_depth"):
         RequestQueue(max_depth=0)
+
+
+class TestFailureReasons:
+    """Reliability satellite: every accepted-then-failed request lands in
+    ``sparkdl_requests_failed_total{reason=...}`` so shed load is
+    observable, and submit-vs-close is deterministic."""
+
+    @staticmethod
+    def _failed(reason):
+        from sparkdl_tpu.observability.registry import registry
+
+        fam = registry().get("sparkdl_requests_failed_total")
+        if fam is None:
+            return 0.0
+        return fam.snapshot_values().get(f'reason="{reason}"', 0.0)
+
+    def test_classification(self):
+        from sparkdl_tpu.reliability.retry import RetryExhaustedError
+        from sparkdl_tpu.serving import (
+            AllReplicasQuarantinedError,
+            HungDispatchError,
+            failure_reason,
+        )
+        from sparkdl_tpu.serving.queue import (
+            DeadlineExceededError,
+            EngineClosedError,
+        )
+
+        assert failure_reason(EngineClosedError("x")) == "closed"
+        assert failure_reason(DeadlineExceededError("x")) == "expired"
+        assert failure_reason(
+            AllReplicasQuarantinedError("x")) == "replica_lost"
+        assert failure_reason(HungDispatchError("x")) == "replica_lost"
+        assert failure_reason(RetryExhaustedError("x")) == "retry_exhausted"
+        assert failure_reason(ValueError("x")) == "error"
+
+    def test_sweep_expired_counts_expired_reason(self):
+        q = RequestQueue(max_depth=8)
+        before = self._failed("expired")
+        futs = [q.submit(i, timeout_s=0.001) for i in range(3)]
+        time.sleep(0.01)
+        q.sweep_expired()
+        for f in futs:
+            with pytest.raises(DeadlineExceededError):
+                f.result(timeout=1)
+        assert self._failed("expired") == before + 3
+
+    def test_fail_pending_counts_closed_reason(self):
+        q = RequestQueue(max_depth=8)
+        before = self._failed("closed")
+        futs = [q.submit(i) for i in range(4)]
+        q.close()
+        assert q.fail_pending() == 4
+        for f in futs:
+            with pytest.raises(EngineClosedError):
+                f.result(timeout=1)
+        assert self._failed("closed") == before + 4
+
+    def test_fail_pending_custom_reason(self):
+        from sparkdl_tpu.serving import AllReplicasQuarantinedError
+
+        q = RequestQueue(max_depth=8)
+        before = self._failed("replica_lost")
+        q.submit(1)
+        q.fail_pending(AllReplicasQuarantinedError("pool gone"))
+        assert self._failed("replica_lost") == before + 1
+
+    def test_submit_after_close_is_deterministic_under_race(self):
+        """A submit racing close() either lands (and stays takeable) or
+        raises EngineClosedError — never a silently dropped Future."""
+        for _ in range(20):
+            q = RequestQueue(max_depth=10_000)
+            barrier = threading.Barrier(2)
+            outcomes = []
+
+            def submitter():
+                barrier.wait()
+                for i in range(50):
+                    try:
+                        outcomes.append(("ok", q.submit(i)))
+                    except EngineClosedError:
+                        outcomes.append(("closed", None))
+
+            def closer():
+                barrier.wait()
+                q.close()
+
+            t1 = threading.Thread(target=submitter)
+            t2 = threading.Thread(target=closer)
+            t1.start(); t2.start(); t1.join(); t2.join()
+            accepted = [f for tag, f in outcomes if tag == "ok"]
+            # every accepted request is still takeable after close
+            taken = []
+            while True:
+                got = q.take(64, 0.0)
+                if not got:
+                    break
+                taken.extend(got)
+            assert len(taken) == len(accepted)
+            # and once closed, submit ALWAYS raises
+            with pytest.raises(EngineClosedError):
+                q.submit("late")
