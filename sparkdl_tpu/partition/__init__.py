@@ -1,0 +1,71 @@
+"""General partitioner layer: dp × tp × fsdp over one mesh.
+
+One subsystem owns every sharding decision in the framework:
+
+- ``rules``: regex ``(pattern, PartitionSpec)`` tables over flattened
+  param paths, first match wins, unmatched-param fail-loud, with
+  per-model defaults (GPT/BERT/ViT) and hit-counts in the metrics spine.
+- ``partitioner``: the :class:`Partitioner` surface —
+  :class:`SingleDevicePartitioner` (a ReplicaPool executor),
+  :class:`DataParallelPartitioner` (dp + optional ZeRO opt-state
+  sharding), :class:`SPMDPartitioner` (rule-placed params, explicit
+  shardings at every jit boundary).
+- ``mesh_factory``: dp × tp × fsdp mesh construction with typed
+  :class:`MeshShapeError` validation (device count in the message).
+- ``zero``: ZeRO-style optimizer-state sharding policy + per-chip
+  memory measurement (``sparkdl_opt_state_bytes{axis}``).
+
+``train/finetune.py``, ``transformers/_inference.py`` (BatchedRunner),
+and ``serving/replicas.py`` (ReplicaPool) construct their shardings
+exclusively through this layer.
+"""
+
+from sparkdl_tpu.partition.mesh_factory import (
+    MeshShapeError,
+    axis_sizes,
+    make_custom_mesh,
+    make_mesh,
+)
+from sparkdl_tpu.partition.partitioner import (
+    DataParallelPartitioner,
+    Partitioner,
+    SPMDPartitioner,
+    SingleDevicePartitioner,
+)
+from sparkdl_tpu.partition.rules import (
+    BERT_RULES,
+    GENERIC_RULES,
+    GPT_RULES,
+    VIT_RULES,
+    PartitionRuleError,
+    default_rules_for,
+    match_partition_rules,
+    rule_hit_counts,
+)
+from sparkdl_tpu.partition.zero import (
+    export_opt_state_bytes,
+    opt_state_bytes_per_chip,
+    zero_partition_specs,
+)
+
+__all__ = [
+    "MeshShapeError",
+    "axis_sizes",
+    "make_custom_mesh",
+    "make_mesh",
+    "Partitioner",
+    "SingleDevicePartitioner",
+    "DataParallelPartitioner",
+    "SPMDPartitioner",
+    "PartitionRuleError",
+    "match_partition_rules",
+    "rule_hit_counts",
+    "default_rules_for",
+    "GPT_RULES",
+    "BERT_RULES",
+    "VIT_RULES",
+    "GENERIC_RULES",
+    "zero_partition_specs",
+    "opt_state_bytes_per_chip",
+    "export_opt_state_bytes",
+]

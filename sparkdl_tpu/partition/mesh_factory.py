@@ -1,0 +1,86 @@
+"""dp × tp × fsdp mesh construction with loud validation.
+
+Generalizes ``runtime/mesh.py``'s data-parallel-only builders into the
+partitioner subsystem's front door: named keyword axes over the
+canonical :data:`~sparkdl_tpu.runtime.mesh.AXIS_ORDER`, at most one
+``-1`` axis inferred from the device count, and **typed errors at
+construction time** — a non-divisor axis size or duplicate axis name
+raises :class:`MeshShapeError` with the device count in the message,
+instead of surfacing as an opaque reshape/GSPMD error deep inside the
+first jit (the failure mode that motivated this module).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from sparkdl_tpu.runtime.mesh import (
+    MeshShapeError,
+    MeshSpec,
+    resolve_axis_sizes,
+)
+
+__all__ = ["MeshShapeError", "make_mesh", "make_custom_mesh", "axis_sizes"]
+
+
+def make_mesh(*, dp: int = -1, pp: int = 1, fsdp: int = 1, sp: int = 1,
+              tp: int = 1, ep: int = 1,
+              devices: "Sequence[jax.Device] | None" = None) -> Mesh:
+    """Build a mesh over the canonical axes (``dp`` inferred by default).
+
+    >>> make_mesh(dp=4, fsdp=2)          # 8 devices: 4-way dp, 2-way zero
+    >>> make_mesh(tp=4)                  # dp inferred = n_devices // 4
+
+    Every axis is always present (size-1 axes are inert), so
+    ``PartitionSpec``\\ s naming any canonical axis resolve on any mesh
+    from this factory. Bad shapes raise :class:`MeshShapeError` naming
+    the axis sizes and the device count.
+    """
+    if devices is None:
+        devices = jax.devices()
+    sizes = dict(dp=dp, pp=pp, fsdp=fsdp, sp=sp, tp=tp, ep=ep)
+    for name, size in sizes.items():
+        if not isinstance(size, (int, np.integer)) or (size < 1 and size != -1):
+            raise MeshShapeError(
+                f"mesh axis {name}={size!r} invalid: sizes are ints >= 1, "
+                f"or one -1 to infer from the {len(devices)} devices"
+            )
+    return MeshSpec(**sizes).build(devices)
+
+
+def make_custom_mesh(axes: "Sequence[tuple[str, int]]",
+                     devices: "Sequence[jax.Device] | None" = None) -> Mesh:
+    """Mesh over caller-named axes (non-canonical layouts, tests).
+
+    Validates what ``jax.sharding.Mesh`` would otherwise let fail later:
+    duplicate/overlapping axis names, non-positive sizes, and a product
+    that does not match the device count all raise
+    :class:`MeshShapeError` up front. At most one size may be ``-1``
+    (inferred).
+    """
+    if devices is None:
+        devices = jax.devices()
+    names = [n for n, _ in axes]
+    dupes = sorted({n for n in names if names.count(n) > 1})
+    if dupes:
+        raise MeshShapeError(
+            f"overlapping mesh axis name(s) {dupes}: each of the "
+            f"{len(devices)} devices can sit on an axis only once"
+        )
+    # -1 inference / size / product validation is runtime.mesh's one
+    # implementation (MeshSpec.resolve shares it)
+    resolved = resolve_axis_sizes(dict(axes), len(devices))
+    arr = np.asarray(devices, dtype=object).reshape(
+        tuple(resolved[n] for n in names))
+    return Mesh(arr, tuple(names))
+
+
+def axis_sizes(mesh: "Mesh | None") -> "dict[str, int]":
+    """``{axis: size}`` for a mesh (``{}`` for the no-mesh case)."""
+    if mesh is None:
+        return {}
+    return {name: int(mesh.shape[name]) for name in mesh.axis_names}

@@ -34,6 +34,51 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXIS_ORDER = ("dp", "pp", "fsdp", "sp", "tp", "ep")
 
 
+class MeshShapeError(ValueError):
+    """A requested parallelism layout cannot be laid over the available
+    devices (non-divisor axis sizes, duplicate axis names, bad product).
+
+    Raised at mesh-construction time with the device count in the message
+    — the alternative is an opaque reshape/jit error long after the bad
+    shape was chosen (partition/mesh_factory.py is the loud front door)."""
+
+
+def resolve_axis_sizes(sizes: "dict[str, int]", n_devices: int) -> dict[str, int]:
+    """Resolve an ordered ``{axis: size}`` layout against ``n_devices``:
+    at most one ``-1`` axis is inferred, everything else validated with a
+    typed :class:`MeshShapeError` naming the device count. The one
+    implementation behind :meth:`MeshSpec.resolve` and
+    ``partition.mesh_factory``'s custom-axes builder."""
+    sizes = dict(sizes)
+    unknown = [a for a, s in sizes.items() if s == -1]
+    if len(unknown) > 1:
+        raise MeshShapeError(
+            f"more than one -1 axis to infer: {unknown}"
+        )
+    bad = {a: s for a, s in sizes.items() if s != -1 and s < 1}
+    if bad:
+        raise MeshShapeError(
+            f"mesh axis sizes must be >= 1 (or one -1 to infer), got "
+            f"{bad} over {n_devices} devices"
+        )
+    known = math.prod(s for s in sizes.values() if s != -1)
+    if unknown:
+        if n_devices % known != 0:
+            raise MeshShapeError(
+                f"{n_devices} devices not divisible by the fixed axes "
+                f"product {known} "
+                f"({ {a: s for a, s in sizes.items() if s not in (1, -1)} })"
+            )
+        sizes[unknown[0]] = n_devices // known
+    elif known != n_devices:
+        raise MeshShapeError(
+            f"mesh axes product {known} "
+            f"({ {a: s for a, s in sizes.items() if s != 1} }) != "
+            f"device count {n_devices}"
+        )
+    return sizes
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
     """Logical parallelism layout, independent of physical device count.
@@ -55,22 +100,7 @@ class MeshSpec:
 
     def resolve(self, n_devices: int) -> dict[str, int]:
         """Fill in the single -1 axis from n_devices; validate the product."""
-        sizes = self.sizes()
-        unknown = [a for a, s in sizes.items() if s == -1]
-        if len(unknown) > 1:
-            raise ValueError(f"MeshSpec has more than one -1 axis: {unknown}")
-        known = math.prod(s for s in sizes.values() if s != -1)
-        if unknown:
-            if n_devices % known != 0:
-                raise ValueError(
-                    f"{n_devices} devices not divisible by fixed axes product {known}"
-                )
-            sizes[unknown[0]] = n_devices // known
-        elif known != n_devices:
-            raise ValueError(
-                f"MeshSpec product {known} != device count {n_devices}"
-            )
-        return sizes
+        return resolve_axis_sizes(self.sizes(), n_devices)
 
     def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
         if devices is None:

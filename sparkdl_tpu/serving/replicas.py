@@ -308,6 +308,7 @@ class ReplicaPool:
                  devices: "list | None" = None,
                  n_replicas: "int | None" = None,
                  make_runner: "Callable[[Any], BatchedRunner] | None" = None,
+                 partitioner_factory: "Callable[[Any], Any] | None" = None,
                  max_failures: int = 3,
                  probation_s: "float | None" = 1.0,
                  probation_max_s: float = 30.0,
@@ -340,11 +341,31 @@ class ReplicaPool:
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         if make_runner is None:
+            # each executor's placement goes through a Partitioner
+            # (sparkdl_tpu/partition): one SingleDevicePartitioner per
+            # replica by default — the pool scales by REPLICATING
+            # single-device partitioners, never by splitting batches.
+            # partitioner_factory(device) swaps in a custom layout per
+            # replica (e.g. an SPMDPartitioner over a per-replica
+            # sub-mesh for models bigger than one chip).
+            from sparkdl_tpu.partition import SingleDevicePartitioner
+
+            if partitioner_factory is None:
+                def partitioner_factory(device):
+                    return SingleDevicePartitioner(device)
+
             def make_runner(device):
                 return BatchedRunner(
                     apply_fn, batch_size=batch_size, data_parallel=False,
-                    device=device, **runner_kwargs,
+                    partitioner=partitioner_factory(device),
+                    **runner_kwargs,
                 )
+        elif partitioner_factory is not None:
+            raise ValueError(
+                "partitioner_factory configures the DEFAULT runner "
+                "construction; with make_runner= the caller owns the "
+                "runner (give its BatchedRunner a partitioner directly)"
+            )
         self.max_failures = max_failures
         self.probation_s = probation_s
         self.probation_max_s = probation_max_s

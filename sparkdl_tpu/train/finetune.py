@@ -18,10 +18,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.observability.tracing import span
+from sparkdl_tpu.partition import DataParallelPartitioner, Partitioner
 from sparkdl_tpu.reliability.faults import fault_point
 from sparkdl_tpu.runtime.completion import AsyncFetcher
 from sparkdl_tpu.runtime.dispatch import (
@@ -30,7 +31,6 @@ from sparkdl_tpu.runtime.dispatch import (
     record_dispatch,
     shape_key,
 )
-from sparkdl_tpu.runtime.mesh import data_parallel_mesh, mesh_context
 
 _M_STEPS = registry().counter(
     "sparkdl_train_steps_total", "optimizer steps taken")
@@ -96,6 +96,7 @@ def finetune_classifier(
     weight_decay: float = 0.01,
     tx: "optax.GradientTransformation | None" = None,
     mesh: Mesh | None = None,
+    partitioner: "Partitioner | None" = None,
     metrics_cb: Callable[[dict], None] | None = None,
     checkpoint_dir: "str | None" = None,
     checkpoint_every: int = 100,
@@ -112,6 +113,18 @@ def finetune_classifier(
     optimizer — pass any optax chain (warmup/cosine schedules,
     ``optax.MultiSteps`` gradient accumulation, clipping, ...) without
     forking the loop.
+
+    ``partitioner`` owns every placement decision (partition/): batch
+    sharding, param/optimizer-state layout, and the step's sharding
+    constraints. Default: :class:`~sparkdl_tpu.partition.
+    DataParallelPartitioner` over ``mesh`` (or all local devices) — the
+    exact historical dp behavior. Pass
+    ``DataParallelPartitioner(make_mesh(dp=4, fsdp=2), zero_axis="fsdp")``
+    for ZeRO-sharded optimizer state (per-chip opt memory ~1/fsdp,
+    measured into ``sparkdl_opt_state_bytes{axis}``), or an
+    :class:`~sparkdl_tpu.partition.SPMDPartitioner` for rule-placed
+    tp/fsdp params. The loss trajectory is invariant across
+    partitioners up to float reduction order.
 
     ``chain_steps`` fuses K optimizer steps into ONE device dispatch
     (``lax.scan`` with the TrainState donated — runtime/dispatch.py),
@@ -137,25 +150,37 @@ def finetune_classifier(
     """
     if chain_steps is not None and chain_steps < 1:
         raise ValueError(f"chain_steps must be >= 1, got {chain_steps}")
-    if mesh is None:
-        mesh = data_parallel_mesh()
+    if partitioner is None:
+        # mesh= keeps its historical meaning: dp over that mesh's data
+        # axes. Anything richer (ZeRO opt-state sharding, rule-placed
+        # tp/fsdp params) is spelled as a Partitioner.
+        partitioner = DataParallelPartitioner(mesh=mesh)
+    elif mesh is not None and partitioner.mesh is not mesh:
+        raise ValueError(
+            "pass either mesh= or partitioner= (the partitioner owns "
+            "its mesh), not both"
+        )
     if tx is None:
         tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+    # one tree convention inside the loop: flax Partitioned boxes are
+    # sharding METADATA, and the partitioner is now the object that owns
+    # placement — unbox up front so params, grads, and optimizer state
+    # all flatten identically (a boxed tx.init against unboxed grads is
+    # a tree-structure mismatch deep inside optax)
+    from sparkdl_tpu.partition.partitioner import _unbox
+
+    params = _unbox(params)
     step_fn = classification_train_step(apply_fn, tx)
-    step = jax.jit(step_fn)
-    chained_step = (chain_carry(step_fn, donate=True)
-                    if chain_steps != 1 else None)
     policy = ChainPolicy(
         max_chain=chain_steps if chain_steps is not None else 32
     )
     if chain_steps is None:
         policy.gap()  # auto mode: calibrate before the loop, not inside
 
-    data_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+    data_sharding = partitioner.batch_sharding()
     # the stacked [K, batch, ...] chain feed: K is the scanned dim,
     # batch stays sharded over the data axes exactly as the single step
-    chain_sharding = NamedSharding(mesh, P(None, ("dp", "fsdp")))
-    repl = NamedSharding(mesh, P())
+    chain_sharding = partitioner.chain_batch_sharding()
     ckpt = None
     if checkpoint_dir is not None:
         from sparkdl_tpu.checkpoint import CheckpointManager
@@ -165,15 +190,28 @@ def finetune_classifier(
             save_interval_steps=checkpoint_every,
         )
     try:
-        with mesh_context(mesh):
+        with partitioner.mesh_context():
             state = TrainState(
-                params=jax.device_put(params, repl),
-                opt_state=jax.device_put(tx.init(params), repl),
+                params=partitioner.shard_params(params),
+                opt_state=partitioner.shard_opt_state(tx.init(params)),
                 # commit the scalar too: an uncommitted device-0 step next
                 # to 8-device params is a mixed-device error under jit on
                 # runtimes without an ambient-mesh auto-commit
-                step=jax.device_put(jnp.zeros((), jnp.int32), repl),
+                step=partitioner.shard_replicated(
+                    jnp.zeros((), jnp.int32)),
             )
+            # the ZeRO memory win (or its absence) is a measured number:
+            # sparkdl_opt_state_bytes{axis} per chip, set once at init
+            partitioner.export_opt_state_bytes(state.opt_state)
+            # pin the output state to the input layout from INSIDE the
+            # trace — survives jit, chain_carry's scan, and donation, so
+            # sharded optimizer state stays sharded across every step
+            state_shardings = jax.tree_util.tree_map(
+                lambda a: a.sharding, state)
+            wrapped_step = partitioner.wrap_step(step_fn, state_shardings)
+            step = jax.jit(wrapped_step)
+            chained_step = (chain_carry(wrapped_step, donate=True)
+                            if chain_steps != 1 else None)
             resume_step = 0
             if ckpt is not None and ckpt.latest_step() is not None:
                 state = ckpt.restore(template=state)

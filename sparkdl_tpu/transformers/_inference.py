@@ -108,7 +108,22 @@ class BatchedRunner:
     #: Pin every dispatch of this runner to ONE device (a ReplicaPool
     #: executor). Implies no local data-parallel sharding — the pool
     #: scales across devices by replication, not by splitting batches.
+    #: Sugar for ``partitioner=SingleDevicePartitioner(device)``.
     device: Any = None
+    #: The placement owner (sparkdl_tpu/partition): every staged batch
+    #: goes through ``partitioner.shard_batch``. None = auto —
+    #: :class:`~sparkdl_tpu.partition.SingleDevicePartitioner` (pinned
+    #: or default device), or a
+    #: :class:`~sparkdl_tpu.partition.DataParallelPartitioner` over the
+    #: local devices when ``data_parallel`` resolves on. Pass one
+    #: explicitly to run this runner over a custom data-parallel mesh
+    #: layout (the chunk/bucket sizes round to its data-axis size).
+    #: Model-axis (tp/fsdp-on-params) layouts are rejected on jax 0.4.x:
+    #: this runner's bare-jit compile relies on implicit GSPMD
+    #: propagation, which 0.4.x miscompiles for such params (PARITY.md)
+    #: — inference through sharded params goes via
+    #: ``Partitioner.wrap_apply``'s explicit shardings instead.
+    partitioner: Any = None
 
     def __post_init__(self):
         self._chainer = ScanChainer(
@@ -124,11 +139,18 @@ class BatchedRunner:
         self._jitted = self._chainer.jit_single
         self._chunk = self.batch_size
         self._buckets = default_buckets(self.batch_size)
-        self._sharding = None
         if self.fetch_window is not None and self.fetch_window < 1:
             raise ValueError(
                 f"fetch_window must be >= 1, got {self.fetch_window}"
             )
+        # Placement routes through ONE object (sparkdl_tpu/partition):
+        # the partitioner decides where every staged batch lands, and
+        # the chunk/bucket geometry follows its data-axis size.
+        from sparkdl_tpu.partition import (
+            DataParallelPartitioner,
+            SingleDevicePartitioner,
+        )
+
         if self.device is not None:
             if self.data_parallel is True:
                 raise ValueError(
@@ -136,6 +158,46 @@ class BatchedRunner:
                     "scaling is the ReplicaPool's job (one runner per "
                     "device), not this runner's"
                 )
+            if self.partitioner is not None:
+                raise ValueError(
+                    "device= is sugar for partitioner="
+                    "SingleDevicePartitioner(device); pass one or the "
+                    "other, not both"
+                )
+            self._partitioner = SingleDevicePartitioner(self.device)
+            return
+        if self.partitioner is not None:
+            if self.data_parallel is True:
+                raise ValueError(
+                    "partitioner= owns placement; an explicit "
+                    "data_parallel=True would be silently overridden — "
+                    "leave it at None and encode dp in the partitioner's "
+                    "mesh instead"
+                )
+            mesh = getattr(self.partitioner, "mesh", None)
+            model_ways = (
+                mesh.devices.size // self.partitioner.data_axis_size
+                if mesh is not None else 1
+            )
+            if model_ways > 1 and not hasattr(jax, "set_mesh"):
+                # this runner compiles apply_fn with a bare jit (params
+                # are closure constants), i.e. implicit GSPMD
+                # propagation — the form measured to miscompile
+                # tp/model-axis-sharded params on jax 0.4.x (PARITY.md).
+                # Refuse loudly rather than serve silently wrong logits;
+                # per-replica SPMD serving sub-meshes are a ROADMAP
+                # follow-on that will route through wrap_apply's
+                # explicit shardings.
+                raise ValueError(
+                    f"partitioner shards {model_ways}-way over model "
+                    "(non-batch) mesh axes, which this jax 0.4.x "
+                    "runner's implicit-propagation jit miscompiles "
+                    "(PARITY.md) — use a data-parallel layout here, or "
+                    "Partitioner.wrap_apply for explicit-sharding "
+                    "inference"
+                )
+            self._partitioner = self.partitioner
+            self._round_to_data_axes(self._partitioner.data_axis_size)
             return
         n_local = jax.local_device_count()
         if self.data_parallel is True and n_local == 1:
@@ -143,11 +205,9 @@ class BatchedRunner:
                 "data_parallel=True but only one local device; use "
                 "data_parallel=None for auto fallback"
             )
+        self._partitioner = SingleDevicePartitioner()
         if self.data_parallel is not False and n_local > 1:
-            from sparkdl_tpu.runtime.mesh import (
-                batch_sharding,
-                data_parallel_mesh,
-            )
+            from sparkdl_tpu.runtime.mesh import data_parallel_mesh
 
             # never spread a batch thinner than one row per device
             n_use = max(1, min(n_local, self.batch_size))
@@ -158,26 +218,49 @@ class BatchedRunner:
                         "nothing to shard"
                     )
             else:
-                mesh = data_parallel_mesh(jax.local_devices()[:n_use])
-                self._sharding = batch_sharding(mesh)
-                # round the chunk size DOWN to a device multiple (never
-                # above the caller's memory ask): full batches then hit
-                # their bucket exactly instead of paying pad rows forever.
-                # The caller-supplied batch_size field stays untouched —
-                # the rounded value is the private dispatch chunk.
-                self._chunk = max(
-                    n_use, self.batch_size // n_use * n_use
+                self._partitioner = DataParallelPartitioner(
+                    data_parallel_mesh(jax.local_devices()[:n_use])
                 )
-                if self._chunk != self.batch_size:
-                    logging.getLogger(__name__).debug(
-                        "batch_size %d rounded to %d-device dp chunk %d "
-                        "(configured value preserved on .batch_size)",
-                        self.batch_size, n_use, self._chunk,
-                    )
-                self._buckets = tuple(sorted({
-                    -(-b // n_use) * n_use
-                    for b in default_buckets(self._chunk)
-                }))
+                self._round_to_data_axes(n_use)
+
+    def _round_to_data_axes(self, n_use: int) -> None:
+        """Round the dispatch chunk DOWN and the buckets UP to multiples
+        of the partitioner's data-axis size, so the batch dim always
+        divides the mesh (never above the caller's memory ask — the
+        caller-supplied ``batch_size`` field stays untouched; the
+        rounded value is the private dispatch chunk)."""
+        if n_use <= 1:
+            return
+        if self.batch_size < n_use:
+            # only reachable with an explicit partitioner= (the auto-dp
+            # path clamps its device count to batch_size); rounding UP
+            # would dispatch more rows than the caller's memory ask
+            raise ValueError(
+                f"batch_size={self.batch_size} is smaller than the "
+                f"partitioner's {n_use}-way data axes — every dispatch "
+                f"needs at least one row per data-axis device; raise "
+                f"batch_size or use a smaller mesh"
+            )
+        self._chunk = self.batch_size // n_use * n_use
+        if self._chunk != self.batch_size:
+            logging.getLogger(__name__).debug(
+                "batch_size %d rounded to %d-way data-axis chunk %d "
+                "(configured value preserved on .batch_size)",
+                self.batch_size, n_use, self._chunk,
+            )
+        self._buckets = tuple(sorted({
+            -(-b // n_use) * n_use
+            for b in default_buckets(self._chunk)
+        }))
+
+    @property
+    def _sharding(self):
+        """Introspection shim: the batch ``NamedSharding`` when this
+        runner splits batches over a mesh, else None. Derived from the
+        partitioner — placement has exactly one owner."""
+        if getattr(self._partitioner, "mesh", None) is None:
+            return None
+        return self._partitioner.batch_sharding()
 
     @property
     def chunk_size(self) -> int:
@@ -338,15 +421,12 @@ class BatchedRunner:
         return BatchResult(ticket, padded.n_valid, t0)
 
     def _transfer(self, arrays: dict[str, np.ndarray]):
-        if self._sharding is not None:
-            # committed sharded inputs: one shard per local chip, and jit
-            # compiles the apply SPMD over the dp mesh from the sharding
-            return jax.device_put(arrays, self._sharding)
-        if self.device is not None:
-            # replica executor: committed to its device, so jit compiles
-            # and runs there — N pinned runners = N independent chips
-            return jax.device_put(arrays, self.device)
-        return jax.device_put(arrays)
+        # the partitioner owns placement: dp meshes commit one shard per
+        # local chip (jit compiles the apply SPMD from the sharding),
+        # pinned replicas commit to their device, single-device stays
+        # the plain uncommitted put. check=False: every batch through
+        # here is already padded to a bucket rounded to the data axes
+        return self._partitioner.shard_batch(arrays, check=False)
 
 
 class BatchResult:

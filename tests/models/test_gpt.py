@@ -290,24 +290,59 @@ def test_eager_cache_overflow_raises(tiny):
         model.apply(params, ids[:, 6:7], cache=cache)
 
 
-@pytest.mark.skipif(
-    not hasattr(jax, "set_mesh"),
-    reason="jax 0.4.x GSPMD miscompiles this dp+tp-sharded forward: the "
-    "jitted output diverges from the eager forward by >2 abs on the SAME "
-    "committed params, with or without sharding constraints or a mesh "
-    "context (measured on 0.4.37; tp-only meshes are exact). Runs on "
-    "jax >= 0.5.",
-)
 def test_tp_sharded_matches_unsharded(tiny):
+    """dp+tp forward through SPMDPartitioner's EXPLICIT shardings.
+
+    Un-skipped from PR 1: the implicit form (committed params + bare
+    jit, relying on GSPMD propagation) miscompiles on jax 0.4.x — see
+    test_tp_implicit_propagation_miscompile below and PARITY.md. With
+    the partitioner spelling in/out shardings on the jit boundary the
+    same dp=2 x tp=4 forward is exact on 0.4.37 and 0.5+ both."""
+    cfg, model, params, ids = tiny
+    from sparkdl_tpu.partition import GPT_RULES, SPMDPartitioner, make_mesh
+
+    part = SPMDPartitioner(make_mesh(dp=2, tp=4), GPT_RULES)
+    sharded = part.shard_params(params)
+    f = part.wrap_apply(lambda p, x: model.apply(p, x)[0], params)
+    logits_tp = f(sharded, part.shard_batch(ids))
+    from flax.core import meta
+
+    logits_local, _ = model.apply(meta.unbox(params), ids)
+    np.testing.assert_allclose(
+        np.asarray(logits_tp), np.asarray(logits_local), atol=1e-4
+    )
+
+
+def test_tp_implicit_propagation_miscompile(tiny):
+    """Pin the 0.4.x repro the skip used to paper over: the IMPLICIT
+    dp+tp form (committed params, bare jit, GSPMD propagation)
+    miscompiles — jitted output diverges from the eager forward by >1
+    abs on the SAME committed params (measured 2.89 on 0.4.37;
+    tp-only meshes are exact). Runs on every jax: 0.5+ (where
+    propagation compiles correctly) asserts exactness instead, so the
+    PARITY.md caveat is version-pinned in both directions. If a 0.4.x
+    point release fixes propagation, the >1 assert fails loudly — then
+    delete this repro and the explicit-only caveat in PARITY.md."""
     cfg, model, params, ids = tiny
     mesh = MeshSpec(dp=2, tp=4).build()
     sharded = init_sharded(model, jax.random.PRNGKey(0), [ids], mesh)
     with mesh_context(mesh):
         logits_tp, _ = jax.jit(lambda p, x: model.apply(p, x))(sharded, ids)
     logits_local, _ = model.apply(jax.tree.map(jnp.asarray, sharded), ids)
-    np.testing.assert_allclose(
-        np.asarray(logits_tp), np.asarray(logits_local), atol=1e-4
-    )
+    err = float(np.max(np.abs(np.asarray(logits_tp)
+                              - np.asarray(logits_local))))
+    if hasattr(jax, "set_mesh"):  # 0.5+: propagation compiles correctly
+        assert err < 1e-4, (
+            f"jax >= 0.5 implicit GSPMD propagation regressed (max abs "
+            f"err {err}): the 0.4.x-only caveat in PARITY.md no longer "
+            "holds on this version"
+        )
+    else:
+        assert err > 1.0, (
+            f"implicit GSPMD propagation now agrees with eager (max abs "
+            f"err {err}): the 0.4.x miscompile is fixed on this jax — "
+            "drop this repro test and the PARITY.md caveat"
+        )
 
 
 def test_hf_gpt2_weight_fidelity():

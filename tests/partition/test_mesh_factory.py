@@ -1,0 +1,68 @@
+"""Mesh factory: typed, loud shape validation at construction time
+(MeshShapeError with the device count), canonical + custom axes."""
+
+import jax
+import pytest
+
+from sparkdl_tpu.partition import (
+    MeshShapeError,
+    axis_sizes,
+    make_custom_mesh,
+    make_mesh,
+)
+from sparkdl_tpu.runtime.mesh import MeshSpec
+
+
+def test_make_mesh_infers_dp():
+    mesh = make_mesh(tp=4)
+    assert axis_sizes(mesh) == dict(dp=2, pp=1, fsdp=1, sp=1, tp=4, ep=1)
+
+
+def test_make_mesh_dp_tp_fsdp():
+    mesh = make_mesh(dp=2, tp=2, fsdp=2)
+    s = axis_sizes(mesh)
+    assert (s["dp"], s["tp"], s["fsdp"]) == (2, 2, 2)
+
+
+def test_non_divisor_axis_raises_typed_with_device_count():
+    with pytest.raises(MeshShapeError, match="8 devices"):
+        make_mesh(tp=3)  # 8 % 3 != 0
+    with pytest.raises(MeshShapeError, match="8"):
+        make_mesh(dp=2, tp=2)  # fixed product 4 != 8
+
+
+def test_bad_axis_size_raises_typed():
+    with pytest.raises(MeshShapeError, match="dp=0"):
+        make_mesh(dp=0)
+    with pytest.raises(MeshShapeError, match="tp=2.5"):
+        make_mesh(tp=2.5)
+
+
+def test_meshspec_two_unknown_axes_raise():
+    with pytest.raises(MeshShapeError, match="-1"):
+        MeshSpec(dp=-1, fsdp=-1).resolve(8)
+
+
+def test_meshspec_errors_are_valueerrors_still():
+    # MeshShapeError subtypes ValueError: pre-subsystem callers that
+    # caught ValueError keep working
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3).resolve(8)
+
+
+def test_custom_mesh_overlapping_axis_names_raise():
+    with pytest.raises(MeshShapeError, match="overlapping.*'x'"):
+        make_custom_mesh([("x", 2), ("y", 2), ("x", 2)])
+
+
+def test_custom_mesh_builds_and_infers():
+    mesh = make_custom_mesh([("rows", 2), ("cols", -1)])
+    assert axis_sizes(mesh) == {"rows": 2, "cols": 4}
+    assert mesh.devices.size == len(jax.devices())
+
+
+def test_custom_mesh_bad_product_names_device_count():
+    with pytest.raises(MeshShapeError, match="device count 8"):
+        make_custom_mesh([("x", 2), ("y", 2)])
+    with pytest.raises(MeshShapeError, match="8 devices"):
+        make_custom_mesh([("x", 3), ("y", -1)])

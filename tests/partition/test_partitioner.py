@@ -1,0 +1,168 @@
+"""Partitioner surface: placement, ZeRO opt-state sharding, wrapped
+steps keeping state sharded, explicit-sharding SPMD apply, gather."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sparkdl_tpu.partition import (
+    DataParallelPartitioner,
+    GENERIC_RULES,
+    MeshShapeError,
+    SPMDPartitioner,
+    SingleDevicePartitioner,
+    make_mesh,
+    opt_state_bytes_per_chip,
+)
+
+
+def _params():
+    rng = np.random.default_rng(0)
+    return {
+        "dense": {"kernel": jnp.asarray(
+            rng.standard_normal((16, 8)), jnp.float32),
+            "bias": jnp.zeros((8,), jnp.float32)},
+    }
+
+
+def test_single_device_pins_batch():
+    dev = jax.devices()[1]
+    part = SingleDevicePartitioner(dev)
+    out = part.shard_batch({"x": np.ones((4, 2), np.float32)})
+    assert out["x"].devices() == {dev}
+    assert part.data_axis_size == 1
+    assert part.describe()["device"] == str(dev)
+
+
+def test_single_device_wrap_step_is_identity():
+    part = SingleDevicePartitioner()
+    step = lambda s, b: (s, b)
+    assert part.wrap_step(step, None) is step
+
+
+def test_dp_batch_split_params_replicated():
+    part = DataParallelPartitioner(make_mesh(dp=8))
+    batch = part.shard_batch({"x": np.ones((16, 4), np.float32)})
+    assert not batch["x"].sharding.is_fully_replicated
+    params = part.shard_params(_params())
+    assert params["dense"]["kernel"].sharding.is_fully_replicated
+    assert part.data_axis_size == 8
+
+
+def test_dp_rejects_undividable_batch_loudly():
+    part = DataParallelPartitioner(make_mesh(dp=8))
+    with pytest.raises(MeshShapeError, match="leading dim 12"):
+        part.shard_batch({"x": np.ones((12, 4), np.float32)})
+
+
+def test_batch_axes_must_exist_in_mesh():
+    from sparkdl_tpu.partition import make_custom_mesh
+
+    mesh = make_custom_mesh([("data", 8)])
+    with pytest.raises(MeshShapeError, match="dp"):
+        DataParallelPartitioner(mesh)  # default axes (dp, fsdp) absent
+    part = DataParallelPartitioner(mesh, batch_axes=("data",))
+    assert part.data_axis_size == 8
+
+
+def test_zero_opt_state_bytes_drop_per_chip():
+    params = _params()
+    tx = optax.adamw(1e-3, weight_decay=0.01)
+    opt = tx.init(params)
+    repl = DataParallelPartitioner(make_mesh(dp=8))
+    zero = DataParallelPartitioner(make_mesh(dp=4, fsdp=2),
+                                   zero_axis="fsdp")
+    b_repl = opt_state_bytes_per_chip(repl.shard_opt_state(opt))
+    b_zero = opt_state_bytes_per_chip(zero.shard_opt_state(opt))
+    # mu/nu (the bulk) halve; count scalar and the 8-bias shards stay
+    assert b_zero < b_repl
+    kernel_mu = zero.shard_opt_state(opt)[0].mu["dense"]["kernel"]
+    assert "fsdp" in str(kernel_mu.sharding.spec)
+
+
+def test_wrapped_step_keeps_opt_state_sharded():
+    """The with_sharding_constraint inside wrap_step survives jit: after
+    a step, the NEW opt state still lives sharded on fsdp."""
+    params = _params()
+    tx = optax.sgd(0.1, momentum=0.9)  # trace (momentum mirrors params)
+    part = DataParallelPartitioner(make_mesh(dp=4, fsdp=2),
+                                   zero_axis="fsdp")
+    p = part.shard_params(params)
+    o = part.shard_opt_state(tx.init(params))
+
+    def step(state, batch):
+        p, o = state
+        grads = jax.tree_util.tree_map(jnp.ones_like, p)
+        updates, o = tx.update(grads, o, p)
+        return (optax.apply_updates(p, updates), o), jnp.float32(0)
+
+    shardings = jax.tree_util.tree_map(lambda a: a.sharding, (p, o))
+    wrapped = jax.jit(part.wrap_step(step, shardings))
+    (p2, o2), _ = wrapped((p, o), None)
+    mom = o2[0].trace["dense"]["kernel"]
+    assert "fsdp" in str(mom.sharding.spec)
+    assert p2["dense"]["kernel"].sharding.is_fully_replicated
+    assert (opt_state_bytes_per_chip(o2)
+            == opt_state_bytes_per_chip(o))
+
+
+def test_spmd_param_placement_and_divisibility_error():
+    part = SPMDPartitioner(make_mesh(dp=1, fsdp=8), GENERIC_RULES)
+    params = part.shard_params(_params())
+    assert not params["dense"]["kernel"].sharding.is_fully_replicated
+    bad = {"dense": {"kernel": jnp.zeros((6, 4))}}  # 6 % 8 != 0
+    with pytest.raises(MeshShapeError, match="dense/kernel"):
+        part.shard_params(bad)
+
+
+def test_spmd_wrap_apply_matches_local():
+    rng = np.random.default_rng(1)
+    params = {"dense": {"kernel": jnp.asarray(
+        rng.standard_normal((16, 8)), jnp.float32)}}
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    part = SPMDPartitioner(make_mesh(dp=2, fsdp=2, tp=2), GENERIC_RULES)
+
+    def apply_fn(p, x):
+        return jnp.tanh(x @ p["dense"]["kernel"])
+
+    f = part.wrap_apply(apply_fn, params)
+    got = f(part.shard_params(params), part.shard_batch(x))
+    assert not got.sharding.is_fully_replicated  # stayed batch-sharded
+    np.testing.assert_allclose(
+        np.asarray(got), np.tanh(x @ np.asarray(params["dense"]["kernel"])),
+        atol=1e-6)
+
+
+def test_gather_for_checkpoint_replicates():
+    part = SPMDPartitioner(make_mesh(dp=1, fsdp=8), GENERIC_RULES)
+    sharded = part.shard_params(_params())
+    gathered = part.gather_for_checkpoint(sharded)
+    k = gathered["dense"]["kernel"]
+    assert k.sharding.is_fully_replicated
+    np.testing.assert_array_equal(
+        np.asarray(k), np.asarray(_params()["dense"]["kernel"]))
+
+
+def test_describe_shapes_the_bench_fields():
+    part = DataParallelPartitioner(make_mesh(dp=4, fsdp=2),
+                                   zero_axis="fsdp")
+    d = part.describe()
+    assert d["kind"] == "DataParallelPartitioner"
+    assert d["axes"]["fsdp"] == 2 and d["zero_axis"] == "fsdp"
+    assert d["data_axis_size"] == 8
+
+
+def test_export_opt_state_bytes_lands_in_registry():
+    from sparkdl_tpu.observability.registry import registry
+
+    params = _params()
+    opt = optax.adamw(1e-3).init(params)
+    part = DataParallelPartitioner(make_mesh(dp=4, fsdp=2),
+                                   zero_axis="fsdp")
+    n = part.export_opt_state_bytes(part.shard_opt_state(opt))
+    fam = registry().get("sparkdl_opt_state_bytes")
+    assert fam is not None
+    assert fam.labelled_values("axis").get("fsdp") == n

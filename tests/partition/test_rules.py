@@ -1,0 +1,145 @@
+"""Partition-rule matching: golden spec trees for real model param trees,
+first-match-wins ordering, unmatched-param fail-loud, scalar handling,
+and registry-sourced hit counts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.partition import (
+    GENERIC_RULES,
+    GPT_RULES,
+    VIT_RULES,
+    PartitionRuleError,
+    default_rules_for,
+    match_partition_rules,
+    rule_hit_counts,
+)
+from sparkdl_tpu.partition.rules import tree_path_names
+
+
+@pytest.fixture(scope="module")
+def gpt_params():
+    from flax.core import meta
+
+    from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    return meta.unbox(model.init(jax.random.PRNGKey(0), ids))
+
+
+@pytest.fixture(scope="module")
+def vit_params():
+    from flax.core import meta
+
+    from sparkdl_tpu.models.vit import ViTConfig, ViTModel
+
+    cfg = ViTConfig.tiny()
+    model = ViTModel(cfg)
+    x = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+    return meta.unbox(model.init(jax.random.PRNGKey(0), x))
+
+
+def _by_name(specs):
+    return dict(tree_path_names(specs))
+
+
+def test_gpt_golden_spec_tree(gpt_params):
+    specs = match_partition_rules(GPT_RULES, gpt_params)
+    got = _by_name(specs)
+    # attention: q/k/v column-parallel, out_proj row-parallel
+    assert got["params/h_0/attn/q_proj/kernel"] == P("fsdp", "tp")
+    assert got["params/h_1/attn/k_proj/kernel"] == P("fsdp", "tp")
+    assert got["params/h_0/attn/out_proj/kernel"] == P("tp", "fsdp")
+    # MLP: up column-parallel, down row-parallel
+    assert got["params/h_0/up/kernel"] == P("fsdp", "tp")
+    assert got["params/h_0/down/kernel"] == P("tp", "fsdp")
+    # column-parallel biases follow their kernel's tp split
+    assert got["params/h_0/attn/q_proj/bias"] == P("tp")
+    assert got["params/h_0/up/bias"] == P("tp")
+    # embeddings sharded, norms replicated
+    assert got["params/wte/embedding"] == P("tp", "fsdp")
+    assert got["params/ln_f/scale"] == P()
+    assert got["params/h_0/ln_1/bias"] == P()
+    # exhaustive: every param leaf received a spec
+    n_leaves = len(jax.tree_util.tree_leaves(gpt_params))
+    assert len(got) == n_leaves and all(isinstance(s, P) for s in got.values())
+
+
+def test_vit_golden_spec_tree(vit_params):
+    specs = match_partition_rules(VIT_RULES, vit_params)
+    got = _by_name(specs)
+    assert got["params/layer_0/attention/query/kernel"] == P("fsdp", "tp")
+    assert got["params/layer_0/attention/output_dense/kernel"] == P("tp", "fsdp")
+    assert got["params/layer_1/intermediate/kernel"] == P("fsdp", "tp")
+    assert got["params/layer_1/output/kernel"] == P("tp", "fsdp")
+    # 4D conv patch embed: input-patch dims replicated, channel on fsdp
+    assert got["params/patch_embed/kernel"] == P(None, None, None, "fsdp")
+    assert got["params/layernorm/scale"] == P()
+    assert got["params/cls_token"] == P()
+    n_leaves = len(jax.tree_util.tree_leaves(vit_params))
+    assert len(got) == n_leaves
+
+
+def test_first_match_wins():
+    tree = {"a": {"kernel": np.zeros((4, 4))}}
+    rules = (
+        (r"a/kernel$", P("tp", None)),
+        (r"kernel$", P("fsdp", None)),  # would also match; must not win
+    )
+    specs = match_partition_rules(rules, tree)
+    assert specs["a"]["kernel"] == P("tp", None)
+    # reversed order: the broad rule fires first instead
+    specs = match_partition_rules(tuple(reversed(rules)), tree)
+    assert specs["a"]["kernel"] == P("fsdp", None)
+
+
+def test_unmatched_param_fails_loud():
+    tree = {"mystery": {"weights": np.zeros((4, 4))}}
+    with pytest.raises(PartitionRuleError, match="mystery/weights"):
+        match_partition_rules(((r"kernel$", P("fsdp")),), tree)
+
+
+def test_scalars_never_partitioned():
+    tree = {"count": np.zeros(()), "one": np.zeros((1,)),
+            "kernel": np.zeros((4, 2))}
+    # no rule matches the scalars — they must not need one
+    specs = match_partition_rules(((r"kernel$", P("fsdp", None)),), tree)
+    assert specs["count"] == P() and specs["one"] == P()
+    assert specs["kernel"] == P("fsdp", None)
+
+
+def test_optimizer_state_paths_match_param_rules(gpt_params):
+    """One table covers the TrainState: mu/nu mirror the param tree, and
+    re.search finds the param path inside the state path."""
+    import optax
+
+    opt_state = jax.eval_shape(optax.adamw(1e-3).init, gpt_params)
+    specs = match_partition_rules(GPT_RULES, opt_state)
+    got = {n: s for n, s in tree_path_names(specs)}
+    mu_q = [n for n in got if "mu" in n and n.endswith("attn/q_proj/kernel")]
+    assert mu_q and all(got[n] == P("fsdp", "tp") for n in mu_q)
+    # the int32 step count inside adam state stays unpartitioned
+    counts = [n for n in got if n.endswith("count")]
+    assert counts and all(got[n] == P() for n in counts)
+
+
+def test_rule_hit_counts_in_registry(gpt_params):
+    fam = registry().get("sparkdl_partition_rule_hits_total")
+    before = fam.labelled_values("rule") if fam is not None else {}
+    match_partition_rules(GPT_RULES, gpt_params)
+    hits = rule_hit_counts()
+    key = r"attn/(q_proj|k_proj|v_proj)/kernel$"
+    # tiny GPT: 2 layers x 3 projections = 6 new hits on the qkv rule
+    assert hits.get(key, 0) - before.get(key, 0) == 6
+
+
+def test_default_rules_for():
+    assert default_rules_for("GPT2-medium") is GPT_RULES
+    assert default_rules_for("vit_b16") is VIT_RULES
+    assert default_rules_for("resnet50") is GENERIC_RULES
