@@ -38,10 +38,16 @@ def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", 128 if on_accel else 8))
     steps = int(os.environ.get("BENCH_STEPS", 50 if on_accel else 3))
     # Per-dispatch program-launch overhead on the relayed chip is ~2.5 ms —
-    # measurable against a 14 ms program — so the benched unit scans K
+    # measurable against a 14 ms program — so the benched unit chains K
     # batches per dispatch (every image still processed exactly once per
-    # step; PERF.md "scan-K" has the measurements).
-    scan_k = int(os.environ.get("BENCH_SCAN_K", 32 if on_accel else 1))
+    # step; PERF.md "scan-K" has the measurements). Since ISSUE 8 the
+    # chaining runs through the PRODUCTION ScanChainer (runtime/dispatch),
+    # not a hand-rolled scan, so the measured gap is the real dispatch
+    # path's. SPARKDL_TPU_CHAIN_K (the production pin) takes precedence
+    # over BENCH_SCAN_K — the chainer fails loud on conflicting pins.
+    scan_k = int(os.environ.get("SPARKDL_TPU_CHAIN_K")
+                 or os.environ.get("BENCH_SCAN_K")
+                 or (32 if on_accel else 1))
     size = 299 if on_accel else 128  # CPU smoke keeps compile/runtime sane
 
     dtype = jnp.bfloat16 if on_accel else jnp.float32
@@ -72,21 +78,20 @@ def main() -> None:
             )
             return feats.astype(jnp.float32)
 
-    if scan_k == 1:
-        featurize = jax.jit(featurize_one)
-    else:
-        from jax import lax
+    # The production fused-dispatch layer (ISSUE 3 / PERF.md open
+    # re-measure (a)): ScanChainer stacks the K staged batches and runs
+    # one jitted lax.scan per dispatch — the exact path BatchedRunner
+    # and finetune dispatch through, so the measured vs_baseline gap is
+    # the real dispatch path's, not a bench-local harness's.
+    from sparkdl_tpu.runtime.dispatch import ScanChainer
 
-        @jax.jit
-        def featurize(xs):  # [K, B, H, W, 3] uint8 -> [K, B, F]
-            return lax.scan(
-                lambda _, x: (None, featurize_one(x)), None, xs
-            )[1]
+    chainer = ScanChainer(featurize_one, path="bench", chain_k=scan_k)
 
     rng = np.random.default_rng(0)
-    shape = (batch, size, size, 3) if scan_k == 1 else (
-        scan_k, batch, size, size, 3)
-    x = rng.integers(0, 256, shape, dtype=np.uint8)
+    xs_host = [
+        rng.integers(0, 256, (batch, size, size, 3), dtype=np.uint8)
+        for _ in range(scan_k)
+    ]
 
     # Local multi-chip DP (SURVEY.md 2.11a / transformers/_inference.py):
     # BENCH_DP_DEVICES=n shards the batch dim over an n-device dp mesh —
@@ -105,20 +110,32 @@ def main() -> None:
         if batch % dp:
             raise SystemExit(f"BENCH_BATCH {batch} not divisible by {dp}")
         mesh = data_parallel_mesh(jax.devices()[:dp])
-        spec = (jax.sharding.PartitionSpec("dp") if scan_k == 1
-                else jax.sharding.PartitionSpec(None, "dp"))
-        x = jax.device_put(x, jax.sharding.NamedSharding(mesh, spec))
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("dp"))
+        xs = [jax.device_put(x, sharding) for x in xs_host]
     else:
-        x = jax.device_put(x)
+        xs = [jax.device_put(x) for x in xs_host]
 
-    # warmup / compile (scalar read also drains any queued work — the
-    # block_until_ready readiness signal can fire early on relayed backends)
-    float(featurize(x).sum())
+    def stream(n_steps):
+        # each timed "step" feeds the K staged batches once; with
+        # chain_k pinned to K, map_stream fuses them into ONE dispatch
+        for _ in range(n_steps):
+            yield from xs
 
-    t0 = time.perf_counter()
+    # warmup / compile: one full chained dispatch (the chainer blocks
+    # per dispatch; the scalar read drains any queued relay work — the
+    # block_until_ready readiness signal can fire early there)
     last = None
-    for _ in range(steps):
-        last = featurize(x)
+    for last in chainer.map_stream(stream(1)):
+        pass
+    float(last.sum())
+
+    from sparkdl_tpu.runtime.dispatch import dispatch_count
+
+    d_before = dispatch_count("bench")
+    t0 = time.perf_counter()
+    for last in chainer.map_stream(stream(steps)):
+        pass
     # Forced 4-byte read: the dependency chain pins all steps behind it.
     # (One host read costs a relay RTT ~70 ms; steps are sized so it is
     # amortized below 1% — see PERF.md.)
@@ -134,23 +151,20 @@ def main() -> None:
     from sparkdl_tpu.observability.tracing import observe_stage
     from sparkdl_tpu.runtime.dispatch import (
         calibrate_dispatch_gap,
-        dispatch_count,
         overhead_share,
-        record_dispatch,
     )
 
     registry().counter(
         "sparkdl_bench_images_total", "images processed by bench.py"
     ).inc(scan_k * batch * steps)
     observe_stage("bench.featurize_step", dt / steps)
-    # Dispatch spine (ISSUE 3): each timed iteration was ONE dispatch
-    # fusing scan_k batches; the calibrated gap turns the dispatch count
-    # into the overhead share of the measured wall, so the trajectory
-    # captures amortization, not just img/s.
-    for _ in range(steps):
-        record_dispatch("bench", scan_k, dt / steps)
+    # Dispatch spine (ISSUE 3 -> 8): the chainer records every dispatch
+    # itself now (path="bench"); the timed delta is the real dispatch
+    # count of the measured window, and the calibrated gap turns it into
+    # the overhead share of the wall, so the trajectory captures
+    # amortization, not just img/s.
     gap = calibrate_dispatch_gap()
-    n_dispatches = dispatch_count("bench")
+    n_dispatches = dispatch_count("bench") - d_before
     # dp>1 reports AGGREGATE throughput; vs_baseline stays per-chip so the
     # number remains comparable to the single-chip target.
     print(
@@ -164,6 +178,7 @@ def main() -> None:
                 "value": round(images_per_sec, 1),
                 "unit": "images/sec" + ("/chip" if dp == 1 else ""),
                 "vs_baseline": round(images_per_sec / dp / target, 4),
+                "chain_k": scan_k,
                 "dispatch_count": n_dispatches,
                 "dispatch_gap_ms": round(gap * 1e3, 4),
                 "overhead_share": round(

@@ -68,7 +68,17 @@ def main() -> None:
         )
         return feats.astype(jnp.float32)
 
-    runner = BatchedRunner(apply_fn, batch_size=batch)
+    # Autotuned ingest (ISSUE 8): the bench runs the SAME pipeline a
+    # zero-config user gets — decode parallelism, staging depth, chain K
+    # and packer threads all start at their defaults and the tuner
+    # resizes them from the measured starvation / producer-blocked
+    # shares. Env pins (SPARKDL_TPU_PREFETCH, SPARKDL_TPU_CHAIN_K,
+    # BENCH_DECODE_PAR) exclude a knob from tuning.
+    from sparkdl_tpu import ingest
+
+    tuner = ingest.default_tuner()
+    tuner.interval_s = float(os.environ.get("BENCH_AUTOTUNE_INTERVAL", 0.2))
+    runner = BatchedRunner(apply_fn, batch_size=batch, autotune=True)
     flops_per_img = compiled_flops(
         apply_fn,
         {"image": jax.ShapeDtypeStruct((1, size, size, 3), jnp.uint8)},
@@ -79,15 +89,26 @@ def main() -> None:
 
     use_native_decode = native_decode.available()
 
+    def decode_one(raw):
+        if use_native_decode:
+            arr = native_decode.decode_resize(raw, size, size)
+        else:
+            arr = np.asarray(
+                Image.open(io.BytesIO(raw)).resize((size, size)))
+        return {"image": arr}
+
     def rows():
-        for i in range(n_images):
-            raw = jpegs[i % len(jpegs)]
-            if use_native_decode:
-                arr = native_decode.decode_resize(raw, size, size)
-            else:
-                arr = np.asarray(
-                    Image.open(io.BytesIO(raw)).resize((size, size)))
-            yield {"image": arr}
+        # decode rides an ingest map stage whose parallelism is a live
+        # tuner knob: when the feed starves the device, more decode
+        # threads spin up — the tf.data AUTOTUNE win on the real decode
+        # hot path. BENCH_DECODE_PAR pins it.
+        pipe = ingest.Pipeline(
+            (jpegs[i % len(jpegs)] for i in range(n_images)),
+            name="hostfed",
+        ).map(decode_one, max_parallelism=4, env_var="BENCH_DECODE_PAR",
+              name="decode")
+        pipe.autotune(True)
+        return iter(pipe)
 
     from sparkdl_tpu.observability import registry
 
@@ -202,8 +223,12 @@ def main() -> None:
             "texts_per_sec": round(n_texts / t_dt, 1),
             "rode_ring": bool(text_ring),
         },
+        # ISSUE 8: every tuning decision visible, steady-state knobs
+        # embedded (registry-sourced, like dispatch_gap_ms elsewhere)
+        "autotune": ingest.autotune_telemetry(),
         "observability": registry().snapshot(),
     }))
+    tuner.stop()
 
 
 if __name__ == "__main__":

@@ -318,14 +318,72 @@ assert "sparkdl_smoke_total 3" in body, body
 srv.close()
 print("metrics endpoint smoke OK")
 '
+# Autotune smoke (ISSUE 8): a deliberately slow synthetic producer under
+# the tuner must reach the throughput of the best hand-picked setting
+# within a bounded number of decisions, and a fully pinned run must make
+# ZERO tuning decisions.
+JAX_PLATFORMS=cpu python -c '
+import time
+from sparkdl_tpu.ingest import AutoTuner, Pipeline
+
+def slow_fn(x):
+    time.sleep(0.003)  # the synthetic bottleneck: 3 ms of host work/item
+    return x
+
+def run(parallelism, depth, tuner=None, n=400, tail=120):
+    pipe = (Pipeline(range(n), name="smoke")
+            .map(slow_fn, parallelism=parallelism, max_parallelism=4,
+                 name="work")
+            .prefetch(depth, transfer=lambda x: x))
+    if tuner is not None:
+        pipe.autotune(tuner)
+        tuner.start()
+    tail_t0 = None
+    for i, _ in enumerate(pipe):
+        if i == n - tail - 1:
+            tail_t0 = time.perf_counter()
+    rate = tail / (time.perf_counter() - tail_t0)
+    if tuner is not None:
+        tuner.stop()
+    return rate
+
+# best hand-picked setting: parallelism 4 (the map stage is the
+# bottleneck; 4 workers x 3ms ≈ 1333 items/s vs 333 at parallelism 1)
+hand = run(parallelism=4, depth=2)
+
+tuned_tuner = AutoTuner(interval_s=0.05, hysteresis=2, cooldown_ticks=1)
+tuned = run(parallelism=None, depth=None, tuner=tuned_tuner)
+assert tuned_tuner.decision_count >= 1, "tuner never acted on starvation"
+assert tuned_tuner.decision_count <= 12, tuned_tuner.decision_count
+assert tuned >= 0.6 * hand, (
+    f"autotuned steady-state {tuned:.0f}/s < 0.6x hand-tuned {hand:.0f}/s "
+    f"after {tuned_tuner.decision_count} decisions")
+
+pinned_tuner = AutoTuner(interval_s=0.05, hysteresis=2, cooldown_ticks=1)
+run(parallelism=4, depth=2, tuner=pinned_tuner)  # everything pinned
+assert pinned_tuner.decision_count == 0, (
+    "pinned knobs moved", pinned_tuner.decision_count)
+print(f"autotune smoke OK: hand-tuned {hand:.0f}/s, autotuned "
+      f"{tuned:.0f}/s steady-state in {tuned_tuner.decision_count} "
+      "decisions; fully pinned run made 0 decisions")
+'
 # Secondary benches keep the same one-JSON-line contract (values are
 # CPU-smoke only; the real numbers come from the chip — PERF.md).
+# ISSUE 8: both now embed the autotuner decision count + steady-state
+# knob values (registry-sourced) next to the registry snapshot.
 for b in bench_tf_ingest.py bench_hostfed.py; do
   JAX_PLATFORMS=cpu BENCH_IMAGES=64 BENCH_BATCH=16 python "$b" | tail -1 | python -c '
 import json, sys
 rec = json.loads(sys.stdin.readline())
 assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
-print("contract OK:", rec["metric"][:60])
+at = rec["autotune"]
+assert isinstance(at["decisions"], int), at
+assert isinstance(at["knobs"], dict) and at["knobs"], at
+assert "sparkdl_autotune_knob" in rec["observability"], sorted(
+    rec["observability"])
+print("contract OK:", rec["metric"][:60],
+      "autotune:", at["decisions"], "decisions,",
+      len(at["knobs"]), "knobs")
 '
 done
 

@@ -44,6 +44,51 @@ FEED_STATS = {
     "ring_bytes": 0,
 }
 
+#: Autotuned suggestions (sparkdl_tpu/ingest): the ring's slot count is
+#: fixed per stream (native allocations), so its knob lands here and the
+#: NEXT DeviceFeeder stream is built with it; pack threads apply live —
+#: every pack_rows call without an explicit n_threads reads the current
+#: value. None = untuned defaults.
+_TUNED: "dict[str, int | None]" = {"ring_slots": None, "pack_threads": None}
+
+_DEFAULT_PACK_THREADS = 4
+
+
+def tuned_ring_slots(default: int) -> int:
+    """Ring slot count for the next staged stream: the autotuned
+    suggestion when one is set, else ``default``."""
+    v = _TUNED["ring_slots"]
+    return int(v) if v else default
+
+
+def set_tuned_ring_slots(n: "int | None") -> None:
+    _TUNED["ring_slots"] = int(n) if n else None
+
+
+def tuned_pack_threads() -> int:
+    """Threads for the native row-pack memcpy fan-out (live-tunable)."""
+    v = _TUNED["pack_threads"]
+    return int(v) if v else _DEFAULT_PACK_THREADS
+
+
+def set_tuned_pack_threads(n: "int | None") -> None:
+    _TUNED["pack_threads"] = int(n) if n else None
+
+
+def pack_knobs():
+    """The bridge's process-level autotuner knobs (packer parallelism;
+    producer-side: grows when the feed starves the consumer). Ring-slot
+    knobs are per-stream and exported by the ingest ``to_device`` stage
+    instead."""
+    from sparkdl_tpu.ingest.autotune import Knob
+
+    return [Knob(
+        name="native.pack_threads",
+        get=tuned_pack_threads,
+        set=set_tuned_pack_threads,
+        lo=1, hi=8,
+    )]
+
 _METRICS = None
 
 
@@ -164,16 +209,19 @@ def pack_rows(
     bucket: int | None = None,
     row_stride: int | None = None,
     out: np.ndarray | None = None,
-    n_threads: int = 4,
+    n_threads: "int | None" = None,
 ) -> np.ndarray:
     """Pack per-row byte arrays into a padded [bucket, row_stride] uint8
     matrix; rows beyond ``len(rows)`` repeat row 0 (bucketed padding).
 
     ``out`` may be a preallocated buffer (e.g. a ring ``slot_view`` slice)
-    to pack straight into staging memory.
+    to pack straight into staging memory. ``n_threads`` defaults to the
+    live autotuned value (:func:`tuned_pack_threads`).
     """
     if not rows:
         raise ValueError("pack_rows needs at least one row")
+    if n_threads is None:
+        n_threads = tuned_pack_threads()
     srcs = [np.ascontiguousarray(r).view(np.uint8).reshape(-1) for r in rows]
     n = len(srcs)
     stride = row_stride or max(s.nbytes for s in srcs)

@@ -306,7 +306,23 @@ class ScanChainer:
             raise ValueError(f"chain_k must be >= 1, got {chain_k}")
         self.step_fn = step_fn
         self.path = path
-        self.chain_k = chain_k if chain_k is not None else default_chain_k()
+        env_k = default_chain_k()
+        if chain_k is not None and env_k is not None and env_k != chain_k:
+            # two explicit pins that disagree is a misconfiguration the
+            # autotuner must never paper over (ISSUE 8): fail loud
+            raise ValueError(
+                f"conflicting chain-K pins: explicit chain_k={chain_k} "
+                f"vs SPARKDL_TPU_CHAIN_K={env_k} — pin it one way, not "
+                "both"
+            )
+        self.chain_k = chain_k if chain_k is not None else env_k
+        #: True when the chain length was explicitly configured (arg or
+        #: env): the autotuner registers a pinned knob and never moves it
+        self.pinned = self.chain_k is not None
+        self.pin_source = (
+            "chain_k" if chain_k is not None
+            else "SPARKDL_TPU_CHAIN_K" if env_k is not None else None
+        )
         self.policy = policy if policy is not None else ChainPolicy()
         if self.chain_k is None:
             # auto mode consults policy.chain_len() per dispatch: pay the

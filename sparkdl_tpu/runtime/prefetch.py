@@ -39,7 +39,10 @@ def _metrics():
             registry().histogram(
                 "sparkdl_prefetch_buffer_fill",
                 "buffered batches observed at each consumer take",
-                buckets=(0, 1, 2, 3, 4, 6, 8, 16, 32)),
+                # top bound covers the autotuner's depth ceiling (the
+                # old top of 32 clipped every autotuned depth above it
+                # into +Inf, hiding how far ahead the producer ran)
+                buckets=(0, 1, 2, 3, 4, 6, 8, 16, 32, 64, 128, 256)),
             registry().histogram(
                 "sparkdl_prefetch_consumer_wait_seconds",
                 "consumer time blocked waiting on the producer "
@@ -71,6 +74,7 @@ class PrefetchIterator(Iterator[U]):
         # maxsize=0 would make the queue unbounded (prefetch the whole
         # stream); clamp so size<=0 means minimal, not infinite, buffering.
         self._q: queue.Queue = queue.Queue(maxsize=max(1, size))
+        self._size = max(1, size)
         self._err: list[BaseException] = []
         self._stop = threading.Event()
         self._done = False
@@ -155,6 +159,27 @@ class PrefetchIterator(Iterator[U]):
             wait.observe(now - t0)
             tracing.record_span("batch.prefetch_wait", t0, now)
             return item
+
+    @property
+    def depth(self) -> int:
+        """Current buffer depth (batches the producer may run ahead)."""
+        return self._size
+
+    def set_depth(self, size: int) -> None:
+        """Resize the buffer on a LIVE iterator without dropping staged
+        batches (the autotuner's depth knob). Growing lets the producer
+        run further ahead immediately; shrinking below the current fill
+        keeps every staged batch — the producer simply blocks until the
+        consumer drains under the new bound. Queue.maxsize is only read
+        under the queue's own mutex, so flipping it there is exactly the
+        synchronization put()/get() already use."""
+        size = max(1, int(size))
+        q = self._q
+        with q.mutex:
+            self._size = size
+            q.maxsize = size
+            # wake a producer parked in put(): the bound may have grown
+            q.not_full.notify_all()
 
     def close(self) -> None:
         """Stop the producer and release queued buffers. Idempotent."""

@@ -102,6 +102,8 @@ def finetune_classifier(
     checkpoint_every: int = 100,
     keep_checkpoints: int = 3,
     chain_steps: "int | None" = 1,
+    input_prefetch: "int | None" = None,
+    autotune: "bool | None" = None,
 ) -> tuple[Any, list[dict]]:
     """Run the fine-tune loop over ``batches``; returns (params, history).
 
@@ -147,6 +149,17 @@ def finetune_classifier(
     ``checkpoint_every`` steps plus once at the end, and an existing
     checkpoint in that directory is resumed from (already-trained steps are
     skipped) — the barrier-retry resume story from SURVEY.md §5.
+
+    ``input_prefetch`` is the input iterator's host-side readahead depth
+    (sparkdl_tpu/ingest): a background producer keeps that many batches
+    staged ahead of the dispatch loop, so a slow ``batches`` source
+    (decode, augmentation, a remote read) overlaps the device step
+    instead of serializing with it. The batch stream — order, values,
+    resume replay — is exactly the pre-pipeline iterator's (parity
+    pinned by tests/ingest/test_ported_parity.py). None = auto
+    (``SPARKDL_TPU_PREFETCH`` pin, else 2; a live autotuner knob when
+    ``autotune`` resolves on); 0 disables readahead (the strictly
+    consumer-pulled pre-pipeline behavior); an explicit depth pins.
     """
     if chain_steps is not None and chain_steps < 1:
         raise ValueError(f"chain_steps must be >= 1, got {chain_steps}")
@@ -189,6 +202,21 @@ def finetune_classifier(
             checkpoint_dir, keep=keep_checkpoints,
             save_interval_steps=checkpoint_every,
         )
+    # Input pipeline (sparkdl_tpu/ingest): host-side readahead between
+    # the batch source and the dispatch loop. transfer=identity — device
+    # placement stays in run_single/run_chain where the shardings live.
+    from sparkdl_tpu import ingest
+    from sparkdl_tpu.ingest.pipeline import resolve_pin
+
+    feed_depth, feed_pinned, _ = resolve_pin(
+        input_prefetch, "SPARKDL_TPU_PREFETCH", 2, what="input_prefetch")
+    input_pipe: "ingest.Pipeline | None" = None
+    if feed_depth > 0:
+        input_pipe = ingest.Pipeline(batches, name="finetune").prefetch(
+            feed_depth, transfer=lambda b: b, pinned=feed_pinned)
+        if ingest.autotune_enabled(autotune):
+            input_pipe.autotune(True)
+        batches = input_pipe
     try:
         with partitioner.mesh_context():
             state = TrainState(
@@ -385,6 +413,9 @@ def finetune_classifier(
                 ckpt.save(host_step, state, force=True)
             return state.params, history
     finally:
+        if input_pipe is not None:
+            # a crash mid-loop must not leak the readahead producer
+            input_pipe.close()
         if ckpt is not None:
             ckpt.close()
 

@@ -24,7 +24,6 @@ from sparkdl_tpu.reliability.faults import fault_point
 from sparkdl_tpu.runtime.batching import (
     default_buckets,
     pad_to_bucket,
-    rebatch,
 )
 from sparkdl_tpu.runtime.completion import (
     AsyncFetcher,
@@ -36,7 +35,6 @@ from sparkdl_tpu.runtime.dispatch import (
     ScanChainer,
     record_dispatch,
 )
-from sparkdl_tpu.runtime.prefetch import prefetch_to_device
 
 
 @dataclasses.dataclass
@@ -75,7 +73,12 @@ class BatchedRunner:
 
     apply_fn: Callable[[dict[str, Any]], Any]
     batch_size: int = 64
-    prefetch: int = 2
+    #: Staging-pipeline depth (batches in flight ahead of the device).
+    #: None = auto: ``SPARKDL_TPU_PREFETCH`` env pin if set, else 2 —
+    #: and the depth is a live autotuner knob when :attr:`autotune` is
+    #: on. An explicit int (or the env var) PINS the depth and excludes
+    #: it from tuning; both set and disagreeing fails loud.
+    prefetch: "int | None" = None
     ragged_rows: bool = False
     #: None = auto (shard over local devices when there is more than one);
     #: False forces single-device; True demands >1 local device.
@@ -124,8 +127,28 @@ class BatchedRunner:
     #: — inference through sharded params goes via
     #: ``Partitioner.wrap_apply``'s explicit shardings instead.
     partitioner: Any = None
+    #: Online autotuning of the ingest knobs (sparkdl_tpu/ingest): the
+    #: staging depth, the dispatch chain K, and the native packer
+    #: parallelism become live knobs on the process
+    #: :func:`~sparkdl_tpu.ingest.default_tuner`, resized from the
+    #: measured starvation / producer-blocked shares. None = defer to
+    #: ``SPARKDL_TPU_AUTOTUNE`` (default off). Explicitly pinned knobs
+    #: (``prefetch=``, ``chain_k=``, their env pins) are registered for
+    #: visibility but never moved.
+    autotune: "bool | None" = None
 
     def __post_init__(self):
+        from sparkdl_tpu.ingest.pipeline import resolve_pin, unique_name
+
+        self._prefetch_depth, self._prefetch_pinned, _ = resolve_pin(
+            self.prefetch, "SPARKDL_TPU_PREFETCH", 2, what="prefetch")
+        self._prefetch_depth = max(1, self._prefetch_depth)
+        # knob prefix: unique per RUNNER so concurrent autotuned runners
+        # never collide in the tuner's name-keyed registry, while one
+        # runner's successive streams (warmup, then the real run) keep
+        # one stable set of names (identity-checked unregistration
+        # handles the rare same-runner-concurrent-streams case)
+        self._pipe_name = unique_name("batch")
         self._chainer = ScanChainer(
             self.apply_fn, path="batch", chain_k=self.chain_k,
             # auto mode holds K staged batches for the chain on top of
@@ -287,24 +310,50 @@ class BatchedRunner:
         if self.fetch_window is not None:
             return self.fetch_window
         chain = self._chainer.chain_k or self._chainer.policy.max_chain
-        return max(2, self.prefetch) * max(1, chain)
+        return max(2, self._prefetch_depth) * max(1, chain)
 
     def run(self, rows: Iterator[dict[str, np.ndarray]]) -> Iterator[np.ndarray]:
         """Yield one output per input row, in order.
 
         Single-array apply_fns yield arrays; tuple-valued apply_fns (e.g.
         multi-output ingested graphs) yield per-row tuples.
+
+        The feed is one composable ingest pipeline (sparkdl_tpu/ingest):
+        ``rows -> batch(bucketing) -> to_device(ring | prefetch)`` — the
+        stage chain replaces the hand-wired rebatch/_device_feed pair
+        and, with :attr:`autotune` on, exports its depth plus this
+        runner's chain-K and the native packer parallelism as live
+        tuner knobs. Outputs are bitwise-identical to the pre-pipeline
+        path (parity pinned by tests/ingest/test_ported_parity.py).
         """
-        batches = rebatch(rows, self._chunk, self._buckets)
+        from sparkdl_tpu import ingest
+
         # keep (n_valid) alongside the device computation
         metas: list[int] = []
-
-        def host_batches():
-            for b in batches:
-                metas.append(b.n_valid)
-                yield b.arrays
-
-        results = self._device_feed(host_batches())
+        tuning = ingest.autotune_enabled(self.autotune)
+        pname = self._pipe_name
+        pipe = (
+            ingest.Pipeline(rows, name=pname)
+            .batch(self._chunk, self._buckets)
+            .tap(lambda b: metas.append(b.n_valid))
+            .apply(lambda b: b.arrays)
+            .to_device(
+                transfer=self._transfer,
+                depth=self._feed_depth(),
+                ragged=self.ragged_rows,
+                max_bucket=max(self._buckets),
+                pinned=self._prefetch_pinned,
+                # the staging depth may never shrink below the chain
+                # ceiling: a K-chain consumes K staged batches per
+                # dispatch, so depth < K turns chain assembly into the
+                # serialization point (_feed_depth's invariant, kept
+                # under tuning by the knob floor)
+                lo=self._chain_floor(),
+            )
+        )
+        if tuning:
+            pipe.autotune(True, extra_knobs=self._tuning_knobs(pname))
+        results = iter(pipe)
         # Fused dispatch: runs of same-bucket staged batches are chained
         # K-per-dispatch (lax.scan inside one jit) behind the prefetch
         # buffer; ragged tail buckets flush unchained. Output order and
@@ -336,55 +385,71 @@ class BatchedRunner:
             else:
                 yield from arrays[:n]
 
+    def _chain_floor(self) -> int:
+        """The chain ceiling the staging depth must cover: the RESOLVED
+        chain_k (env override included), or the policy ceiling in auto
+        mode since K can ramp there after the first measured dispatch."""
+        return self._chainer.chain_k or self._chainer.policy.max_chain
+
+    def _feed_depth(self) -> int:
+        """Staging depth: a K-chain consumes K staged batches per
+        dispatch, so the pipeline must run at least that far ahead or
+        the chain assembly itself becomes the serialization point."""
+        return max(self._prefetch_depth, self._chain_floor())
+
+    def _tuning_knobs(self, prefix: str) -> "list[Any]":
+        """This runner's non-stage knobs for the autotuner: the dispatch
+        chain K (inverted — it grows when the CONSUMER side lags, i.e.
+        producer-blocked, to amortize per-dispatch overhead) and the
+        native packer parallelism. Pinned chain lengths (explicit
+        ``chain_k=`` or ``SPARKDL_TPU_CHAIN_K``) register pinned so the
+        gauge still exports them but the tuner never moves them."""
+        from sparkdl_tpu.ingest.autotune import Knob
+        from sparkdl_tpu.native import bridge
+
+        ch = self._chainer
+
+        def get_k(ch=ch) -> int:
+            return int(ch.chain_k if ch.chain_k is not None
+                       else ch.policy.chain_len())
+
+        def set_k(v: int, ch=ch) -> None:
+            # map_stream consults target_chain_len() per item, so a live
+            # chain_k write takes effect at the next group boundary.
+            # Growth is clamped to the ChainPolicy's overhead-aware
+            # recommendation: chaining past the K that already holds the
+            # dispatch-gap share under target buys nothing and only
+            # delays host visibility — on a backend with a negligible
+            # gap (local CPU) the recommendation is 1 and the tuner's
+            # grow is a no-op the read-back check discards.
+            ch.chain_k = max(1, min(int(v), ch.policy.chain_len()))
+
+        knobs = [Knob(
+            name=f"{prefix}.chain_k", get=get_k, set=set_k,
+            lo=1, hi=ch.policy.max_chain, inverted=True,
+            pinned=ch.pinned, pin_source=ch.pin_source,
+        )]
+        # the pack-thread knob deliberately keeps its process-global
+        # name: it closes over module-global state shared by every
+        # stream, so all registrations ARE the same knob
+        knobs.extend(bridge.pack_knobs())
+        return knobs
+
     def _device_feed(
         self, host_batches: Iterator[dict[str, np.ndarray]]
     ) -> Iterator[dict[str, Any]]:
         """Stage host batch dicts onto the device with transfer/compute
-        overlap; picks the native ring when it applies."""
-        from sparkdl_tpu.native.bridge import DeviceFeeder, native_available
+        overlap; picks the native ring when it applies. (The streaming
+        entry is :meth:`run`'s pipeline — this is the same ``to_device``
+        stage exposed for direct feeds and introspection.)"""
+        from sparkdl_tpu.ingest.pipeline import _ToDeviceStage
 
-        it = iter(host_batches)
-        try:
-            first = next(it)
-        except StopIteration:
-            return
-        keys = list(first)
-
-        def stream():
-            yield first
-            yield from it
-
-        # a K-chain consumes K staged batches per dispatch, so the
-        # staging pipeline must run at least that far ahead or the chain
-        # assembly itself becomes the serialization point. The chainer's
-        # chain_k is the RESOLVED value (env override included); auto
-        # (None) sizes for the policy ceiling, since K can ramp there
-        # after the first measured dispatch.
-        depth = max(
-            self.prefetch,
-            self._chainer.chain_k or self._chainer.policy.max_chain,
+        stage = _ToDeviceStage(
+            self._transfer, self._feed_depth(), self.ragged_rows,
+            max(self._buckets), None, "device",
+            pinned=self._prefetch_pinned,
         )
-
-        if native_available() and not self.ragged_rows:
-            # struct-of-tensors slots: EVERY uniform feed rides the ring —
-            # single-tensor image batches and multi-tensor text batches
-            # (input_ids+attention_mask) alike (SURVEY.md 2.15 parity:
-            # the reference's bridge moved all blocks natively). Segments
-            # are sized for the LARGEST bucket; the first batch may be a
-            # smaller tail bucket.
-            seg = {
-                k: (first[k].nbytes // max(first[k].shape[0], 1))
-                * max(self._buckets)
-                for k in keys
-            }
-            yield from DeviceFeeder(
-                stream(), n_slots=depth + 1, max_batch_bytes=seg,
-                transfer=self._transfer,
-            )
-            return
-        yield from prefetch_to_device(
-            stream(), size=depth, transfer=self._transfer
-        )
+        return iter(stage.build(iter(host_batches), None))
 
     def run_batch(self, arrays: dict[str, np.ndarray]):
         """One-shot dispatch for the online serving path: pad the stacked
