@@ -191,4 +191,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    # SPARKDL_TPU_PROFILE=1: sample host thread stacks for the whole run
+    # and drop a collapsed-stack file (flamegraph/speedscope) — ISSUE 9
+    from sparkdl_tpu.observability.profiling import maybe_profile
+
+    with maybe_profile("bench"):
+        main()

@@ -149,7 +149,15 @@ assert "sparkdl_dispatch_seconds" in obs, sorted(obs)
 assert 0 <= rec["fetch_wait_share"] <= 1, rec["fetch_wait_share"]
 assert rec["replica_count"] == 1, rec["replica_count"]
 assert "sparkdl_fetch_wait_seconds" in obs, sorted(obs)
-print("bench_serving contract OK (snapshot embedded)")
+# ISSUE 9: declared SLO (objective + rolling burn) and flight-ring volume
+slo = rec["slo"]
+assert slo["latency"]["threshold_s"] > 0, slo
+assert 0 < slo["latency"]["target"] < 1, slo
+assert slo["latency"]["burn_rate"] is not None, slo
+assert slo["availability"]["burn_rate"] is not None, slo
+assert isinstance(rec["flight_events_total"], int), rec["flight_events_total"]
+assert rec["flight_events_total"] > 0, "flight ring saw no events"
+print("bench_serving contract OK (snapshot + slo + flight embedded)")
 '
 
 # Fault-injection smoke (ISSUE 5): resumable_finetune survives an
@@ -267,6 +275,125 @@ print(f"quarantine-reintegration smoke OK: {n_replicas}-replica pool "
       "probation probe")
 '
 
+# Flight-recorder chaos smoke (ISSUE 9 acceptance): a fault-plan-injected
+# replica failure under load must (a) cost no client a result (re-route),
+# (b) quarantine the victim replica, and (c) auto-dump a postmortem
+# bundle whose event ring holds the fault injection + the quarantine
+# transition and whose trace section holds the re-routed request's FULL
+# trace (queue wait, failed replica dispatch, re-routed dispatch,
+# terminal request span).
+FLIGHT_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+  SPARKDL_TPU_TRACE=1 SPARKDL_TPU_FLIGHT_DIR="$FLIGHT_DIR" \
+  SPARKDL_TPU_FAULT_PLAN="replica.execute:RuntimeError@3" python -c '
+import glob, json, os, time
+import numpy as np, jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.observability.flight import flight_recorder
+from sparkdl_tpu.serving import ReplicaPool, ServingEngine
+
+flight_recorder().configure(settle_s=0.3, min_interval_s=0.0)
+w = jnp.asarray(np.random.default_rng(0).standard_normal((8, 8)),
+                jnp.float32)
+# probation off: the quarantine must be a stable end state to assert on
+pool = ReplicaPool(lambda b: jnp.tanh(b["x"] @ w), batch_size=8,
+                   max_failures=1, probation_s=None)
+pool.warmup({"x": np.zeros((8, 8), np.float32)})  # site hits 1 and 2
+with ServingEngine(pool, max_wait_s=0.002) as eng:
+    futs = [eng.submit({"x": np.full((8,), float(i), np.float32)})
+            for i in range(48)]
+    for i, f in enumerate(futs):  # hit 3 injects; its riders re-route
+        np.testing.assert_allclose(
+            f.result(timeout=60),
+            np.tanh(np.full((8,), float(i), np.float32) @ np.asarray(w)),
+            rtol=1e-5)
+    assert pool.snapshot()["healthy_count"] == 1, pool.snapshot()
+    victim = None
+    for f in futs:
+        spans = eng.trace(f.request_id)
+        failed = [s for s in spans if s["name"] == "serving.replica_batch"
+                  and "error" in s["args"]]
+        if failed:
+            victim = (f.request_id, spans)
+            break
+    assert victim, "no request trace crossed the injected failure"
+    rid, spans = victim
+    names = {s["name"] for s in spans}
+    assert {"serving.queue_wait", "serving.replica_batch",
+            "serving.request"} <= names, names
+    # the re-route shows as a SECOND replica dispatch in the same trace
+    assert len([s for s in spans
+                if s["name"] == "serving.replica_batch"]) >= 2, names
+    deadline = time.monotonic() + 15.0
+    paths = []
+    while not paths and time.monotonic() < deadline:
+        paths = glob.glob(os.path.join(
+            os.environ["SPARKDL_TPU_FLIGHT_DIR"], "flight-*.json"))
+        time.sleep(0.05)
+    assert paths, "no postmortem bundle written"
+    bundle = json.load(open(sorted(paths)[-1]))
+pool.close()
+assert bundle["reason"] == "replica_quarantined", bundle["reason"]
+events = bundle["events"]
+assert any(e["kind"] == "fault.injected"
+           and e.get("site") == "replica.execute" for e in events), \
+    sorted({e["kind"] for e in events})
+assert any(e["kind"] == "replica.quarantined" for e in events)
+bundle_spans = {e["args"]["span_id"] for e in bundle["trace_events"]}
+missing = [s["name"] for s in spans
+           if s["args"]["span_id"] not in bundle_spans]
+assert not missing, f"victim trace spans missing from bundle: {missing}"
+assert any(p.get("healthy_count") == 1
+           for p in bundle["context"].values()
+           if isinstance(p, dict) and "healthy_count" in p), \
+    "bundle lacks the pool quarantine state"
+print(f"flight-recorder chaos smoke OK: injected replica fault -> "
+      f"quarantine + postmortem bundle with {len(events)} events, "
+      f"victim request {rid} trace ({len(spans)} spans) fully captured")
+'
+rm -rf "$FLIGHT_DIR"
+# Disabled-path overhead guard (ISSUE 9 acceptance): flight-recorder
+# append + per-request trace-ID plumbing (tracing OFF) must together
+# stay under 1% of one BatchedRunner dispatch.
+JAX_PLATFORMS=cpu python -c '
+import time
+import numpy as np, jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.observability import tracing
+from sparkdl_tpu.observability.flight import flight_recorder
+from sparkdl_tpu.transformers._inference import BatchedRunner
+
+assert not tracing.tracing_enabled()
+rec = flight_recorder()
+n = 200_000
+t0 = time.perf_counter()
+for _ in range(n):
+    rec.record("overhead.guard", site="x")
+per_append = (time.perf_counter() - t0) / n
+assert per_append < 2e-6, f"flight append {per_append*1e9:.0f}ns/event"
+t0 = time.perf_counter()
+for _ in range(n):
+    rid = tracing.next_request_id()
+    tracing.request_context(rid)  # None with tracing off: id is the cost
+per_rid = (time.perf_counter() - t0) / n
+assert per_rid < 2e-6, f"trace-ID plumbing {per_rid*1e9:.0f}ns/request"
+rng = np.random.default_rng(0)
+w = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+r = BatchedRunner(lambda b: jnp.tanh(b["x"] @ w), batch_size=8,
+                  data_parallel=False)
+rows = [{"x": rng.standard_normal(8).astype(np.float32)}
+        for _ in range(64)]
+list(r.run(iter(rows)))  # warm the jit cache
+t0 = time.perf_counter()
+list(r.run(iter({"x": row["x"]} for row in rows)))
+per_dispatch = (time.perf_counter() - t0) / 8
+share = (per_append + per_rid) / per_dispatch
+assert share < 0.01, (per_append, per_rid, per_dispatch)
+print(f"flight/trace disabled-path overhead OK: append "
+      f"{per_append*1e9:.0f}ns + request-id {per_rid*1e9:.0f}ns = "
+      f"{100*share:.3f}% of one BatchedRunner dispatch")
+'
+
 # Partitioner/ZeRO smoke (ISSUE 6): an fsdp=2 finetune on 2 forced
 # virtual CPU devices must (a) measure per-chip optimizer-state bytes
 # BELOW the replicated dp baseline (registry gauge
@@ -305,8 +432,9 @@ print(f"partitioner ZeRO smoke OK: opt-state {b_sharded:.0f}B/chip sharded "
 # does (SPARKDL_TPU_METRICS_PORT -> maybe_start_metrics_server), scrape
 # once, assert well-formed Prometheus exposition text.
 JAX_PLATFORMS=cpu SPARKDL_TPU_METRICS_PORT=0 python -c '
-import urllib.request
+import json, urllib.request
 from sparkdl_tpu.observability import maybe_start_metrics_server, registry
+from sparkdl_tpu.observability import flight, slo
 registry().counter("sparkdl_smoke_total", "endpoint smoke").inc(3)
 srv = maybe_start_metrics_server()
 assert srv is not None, "SPARKDL_TPU_METRICS_PORT=0 must start the server"
@@ -315,8 +443,24 @@ body = urllib.request.urlopen(
     f"http://127.0.0.1:{srv.port}/metrics", timeout=5).read().decode()
 assert "# TYPE sparkdl_smoke_total counter" in body, body
 assert "sparkdl_smoke_total 3" in body, body
+# ISSUE 9 endpoints: /slo.json lists registered trackers, /healthz
+# aggregates reliability state, /debug/flight serves a live bundle
+tracker = slo.register(slo.SLOTracker(slo.SLO(
+    name="smoke", latency_threshold_s=0.1)))
+doc = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{srv.port}/slo.json", timeout=5).read())
+assert any(s.get("slo") == "smoke" for s in doc["slos"]), doc
+slo.unregister(tracker)
+hz = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{srv.port}/healthz", timeout=5).read())
+assert hz["status"] == "ok" and "retry_budget" in hz, hz
+flight.record_event("endpoint.smoke")
+fl = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{srv.port}/debug/flight", timeout=5).read())
+assert any(e["kind"] == "endpoint.smoke"
+           for e in fl["bundle"]["events"]), fl["bundle"]["events"][-3:]
 srv.close()
-print("metrics endpoint smoke OK")
+print("metrics endpoint smoke OK (/metrics /slo.json /healthz /debug/flight)")
 '
 # Autotune smoke (ISSUE 8): a deliberately slow synthetic producer under
 # the tuner must reach the throughput of the best hand-picked setting

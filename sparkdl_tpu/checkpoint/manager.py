@@ -39,6 +39,7 @@ from typing import Any
 
 import jax
 
+from sparkdl_tpu.observability import flight
 from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.observability.tracing import record_span, span
 from sparkdl_tpu.reliability.faults import fault_point
@@ -72,6 +73,26 @@ MANIFEST_NAME = "sparkdl_integrity.json"
 class CheckpointCorruptError(RuntimeError):
     """The requested checkpoint failed integrity verification (and, for
     latest-step restores, so did every older candidate)."""
+
+
+def _integrity_verdict(verdict: str, *, step: "int | None" = None,
+                       directory: "str | None" = None,
+                       pinned: bool = False) -> None:
+    """Publish the restore-side integrity verdict for ``/healthz`` and
+    postmortems. ``intact`` / ``fallback`` / ``unreadable`` (every
+    candidate failed but with NO digest mismatch — possibly the
+    caller's template, so it only degrades health) / ``corrupt``
+    (digest-verified damage; drives unhealthy unless ``pinned`` — a
+    pinned-step failure says nothing about the newer history). The fact
+    is a latch until the next successful restore publishes ``intact``/
+    ``fallback``."""
+    flight.set_health_fact("checkpoint_integrity", {
+        "verdict": verdict,
+        "step": step,
+        "directory": directory,
+        "pinned": pinned,
+        "time_unix": time.time(),
+    })
 
 
 def checkpoint_digest(step_dir: str) -> dict:
@@ -351,13 +372,34 @@ class CheckpointManager:
         #: that is corruption or a bad template only becomes clear when
         #: an older candidate restores (or none does) — see below
         suspects: "list[int]" = []
+        #: True once any candidate showed a DIGEST mismatch — the only
+        #: evidence strong enough to publish a "corrupt" health verdict
+        #: when no candidate restores (all-suspects failures may be the
+        #: caller's template and must not 503 the host forever)
+        definite_corruption = False
         for i, s in enumerate(candidates):
             ok = self.verify(s, _actual=fresh.get(int(s)))
             if ok is False:
                 _M_CORRUPT.inc()
+                definite_corruption = True
+                flight.record_event(
+                    "checkpoint.corrupt", step=int(s),
+                    directory=self.directory,
+                )
                 msg = f"step {s}: integrity digest mismatch (torn write?)"
                 _log.error("checkpoint %s under %s", msg, self.directory)
                 if pinned:
+                    # pinned=True in the fact: the damage is confined to
+                    # the REQUESTED step; newer intact history may exist,
+                    # so /healthz degrades instead of going unhealthy
+                    _integrity_verdict("corrupt", step=int(s),
+                                       directory=self.directory,
+                                       pinned=True)
+                    # inline dump (settle_s=0): the raise below is often
+                    # process-fatal, and a daemon settle timer would die
+                    # with the interpreter before writing the bundle
+                    flight.trigger_dump("checkpoint_corrupt",
+                                        settle_s=0, step=int(s))
                     raise CheckpointCorruptError(
                         f"requested checkpoint {msg} under {self.directory}"
                     )
@@ -395,15 +437,36 @@ class CheckpointManager:
             # unreadable, so counting and quarantining them is safe now
             for sus in suspects:
                 _M_CORRUPT.inc()
+                flight.record_event(
+                    "checkpoint.corrupt", step=int(sus),
+                    directory=self.directory,
+                )
                 self._quarantine_step(sus)
             if i > 0:
                 _M_FALLBACKS.inc()
+                flight.record_event(
+                    "checkpoint.fallback", step=int(s),
+                    skipped=i, directory=self.directory,
+                )
+                _integrity_verdict("fallback", step=int(s),
+                                   directory=self.directory)
                 _log.warning(
                     "restored fallback step %s under %s (newer "
                     "candidate(s) corrupt: %s)",
                     s, self.directory, "; ".join(errors),
                 )
+            else:
+                _integrity_verdict("intact", step=int(s),
+                                   directory=self.directory)
             return out
+        # only digest-verified damage may 503 the host; every-candidate
+        # restore failures without a mismatch could be the caller's
+        # template (wrong shape/sharding) and merely degrade health
+        _integrity_verdict(
+            "corrupt" if definite_corruption else "unreadable",
+            directory=self.directory)
+        # inline (settle_s=0): the raise below may end the process
+        flight.trigger_dump("checkpoint_corrupt", settle_s=0)
         raise CheckpointCorruptError(
             f"no intact checkpoint under {self.directory}: "
             + "; ".join(errors)

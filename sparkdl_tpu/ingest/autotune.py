@@ -43,7 +43,7 @@ import threading
 import time
 from typing import Callable, Iterable
 
-from sparkdl_tpu.observability import tracing
+from sparkdl_tpu.observability import flight, tracing
 from sparkdl_tpu.observability.registry import registry
 
 __all__ = [
@@ -337,9 +337,15 @@ class AutoTuner:
             before[knob.name] = cur
             moved += 1
             self.decision_count += 1
-            decisions_m.inc(knob=knob.name,
-                            direction="grow" if new > cur else "shrink")
+            direction_s = "grow" if new > cur else "shrink"
+            decisions_m.inc(knob=knob.name, direction=direction_s)
             gauge_m.set(float(new), knob=knob.name)
+            # the decision HISTORY is what postmortems need (tf.data's
+            # AUTOTUNE lesson): the knob value alone hides the causality
+            flight.record_event(
+                "autotune.decision", knob=knob.name,
+                direction=direction_s, value=new, previous=cur,
+            )
         if moved:
             self._pending_eval = (direction, before, rate)
             tracing.record_span(
@@ -363,6 +369,10 @@ class AutoTuner:
             self.decision_count += 1
             decisions_m.inc(knob=name, direction="revert")
             gauge_m.set(float(int(knob.get())), knob=name)
+            flight.record_event(
+                "autotune.decision", knob=name, direction="revert",
+                value=old,
+            )
         self._tabu[direction] = self.tabu_ticks
         self._cooldown = self.cooldown_ticks
         if moved:

@@ -4,9 +4,12 @@ Three ways out of :func:`sparkdl_tpu.observability.registry.registry`:
 
 * :class:`MetricsServer` — stdlib ``http.server`` serving the Prometheus
   text exposition on ``/metrics`` (and the JSON snapshot on
-  ``/metrics.json``); opt-in per process via ``SPARKDL_TPU_METRICS_PORT``
-  (:func:`maybe_start_metrics_server`), so a serving host or TPU worker
-  becomes scrape-able with zero dependencies;
+  ``/metrics.json``, SLO burn on ``/slo.json``, the reliability health
+  aggregate on ``/healthz``, and a live flight-recorder bundle on
+  ``/debug/flight`` — ISSUE 9); opt-in per process via
+  ``SPARKDL_TPU_METRICS_PORT`` (:func:`maybe_start_metrics_server`), so
+  a serving host or TPU worker becomes scrape-able with zero
+  dependencies;
 * ``registry().snapshot()`` — the JSON form benches and
   ``dryrun_multichip`` embed in their artifacts (no exporter needed);
 * :class:`PeriodicLogEmitter` — a daemon thread logging a compact
@@ -44,20 +47,58 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
         path = self.path.split("?", 1)[0]
-        if path in ("/metrics", "/"):
-            body = self.registry.to_prometheus().encode()
-            ctype = PROMETHEUS_CONTENT_TYPE
-        elif path == "/metrics.json":
-            body = json.dumps(self.registry.snapshot()).encode()
-            ctype = "application/json"
-        else:
-            self.send_error(404)
+        status = 200
+        try:
+            if path in ("/metrics", "/"):
+                self._refresh_slo_gauges()
+                body = self.registry.to_prometheus().encode()
+                ctype = PROMETHEUS_CONTENT_TYPE
+            elif path == "/metrics.json":
+                body = json.dumps(self.registry.snapshot()).encode()
+                ctype = "application/json"
+            elif path == "/slo.json":
+                # ISSUE 9: every registered SLO tracker's rolling
+                # compliance / error-budget burn, sampled at scrape time
+                from sparkdl_tpu.observability import slo
+
+                body = json.dumps(
+                    {"slos": slo.slo_report()}, default=repr).encode()
+                ctype = "application/json"
+            elif path == "/healthz":
+                # aggregate reliability state for a router-tier health
+                # check: 503 only when this host cannot serve at all
+                from sparkdl_tpu.observability import flight
+
+                report = flight.healthz_report()
+                status = 503 if report["status"] == "unhealthy" else 200
+                body = json.dumps(report, default=repr).encode()
+                ctype = "application/json"
+            elif path == "/debug/flight":
+                from sparkdl_tpu.observability import flight
+
+                body = json.dumps(
+                    flight.flight_recorder().debug_view(),
+                    default=repr).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+        except Exception:
+            logger.exception("exporter: %s handler failed", path)
+            self.send_error(500)
             return
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _refresh_slo_gauges(self):
+        """Refresh sparkdl_slo_* gauges so a Prometheus scrape of
+        /metrics sees current burn rates (trackers are pull-sampled)."""
+        from sparkdl_tpu.observability import slo
+
+        slo.sample_all()
 
     def log_message(self, fmt, *args):  # scrapes must not spam stdout
         logger.debug("metrics scrape: " + fmt, *args)

@@ -265,6 +265,36 @@ class MetricFamily:
                 out[label_str] = v
         return out
 
+    def count_below(self, bound: float) -> "tuple[float, int]":
+        """Histogram-only: ``(observations <= bound, total observations)``
+        summed across every label series, interpolating linearly inside
+        the bucket that straddles ``bound`` — the same linearity as the
+        percentile readout, and the SLO tracker's compliance source
+        ("what fraction of requests beat the latency objective").
+        Overflow-bucket observations (> the last finite bound) are never
+        counted good: conservative when the objective exceeds the bucket
+        range."""
+        if self.kind != "histogram":
+            raise ValueError(
+                f"{self.name} is a {self.kind}; count_below() is "
+                "histogram-only"
+            )
+        good = 0.0
+        total = 0
+        for _key, v in self._copy_series():
+            total += v.n
+            lo = 0.0
+            for i, b in enumerate(self.bucket_bounds):
+                c = v.counts[i]
+                if bound >= b:
+                    good += c
+                else:
+                    if bound > lo and b > lo:
+                        good += c * (bound - lo) / (b - lo)
+                    break
+                lo = b
+        return good, total
+
     def labelled_values(self, label: str) -> dict:
         """Scalar series keyed by ONE label dimension's value —
         the structured accessor for programmatic consumers (parsing the

@@ -18,6 +18,17 @@ a thread; across threads (a submitting caller → the MicroBatcher worker)
 the producer captures :func:`current_context` and the consumer re-roots
 with :func:`attach` — the pattern ``serving/queue.py`` uses so a request's
 queue-wait and device-step spans hang off the submitter's trace.
+
+Per-request traces (ISSUE 9): every serving request is allocated a
+process-unique id at ``RequestQueue.submit`` (:func:`next_request_id` —
+an int, the ONLY per-request cost with tracing off) that doubles as its
+trace id. :func:`request_context` roots the request's trace; stage spans
+(queue wait, prefill, the terminal ``serving.request``) parent on it,
+while batch-level spans — one device dispatch serving many riders — run
+in their OWN trace carrying a ``links=[request ids...]`` attribute that
+fans them into every rider's trace. :func:`spans_for_trace` resolves one
+request id to its full span set (direct spans + linked batch traces);
+``ServingEngine.trace(request_id)`` is the operator surface over it.
 """
 
 from __future__ import annotations
@@ -40,9 +51,13 @@ __all__ = [
     "disable_tracing",
     "enable_tracing",
     "export_chrome_trace",
+    "new_trace_context",
+    "next_request_id",
     "observe_stage",
     "record_span",
+    "request_context",
     "span",
+    "spans_for_trace",
     "trace_events",
     "tracing_enabled",
 ]
@@ -124,6 +139,33 @@ def current_context() -> "SpanContext | None":
 def _next_id() -> int:
     with _ids_lock:
         return next(_ids)
+
+
+def next_request_id() -> int:
+    """Process-unique id for one serving request; doubles as its trace
+    id. Allocated unconditionally at submit — with tracing disabled this
+    int is the ONLY per-request tracing cost (guarded by run-tests.sh)."""
+    with _ids_lock:
+        return next(_ids)
+
+
+def request_context(request_id: int) -> "SpanContext | None":
+    """Root span context of one request's trace (``trace_id`` IS the
+    request id). None with tracing off — zero allocation there."""
+    if not _enabled:
+        return None
+    return SpanContext(request_id, request_id)
+
+
+def new_trace_context() -> "SpanContext | None":
+    """Root context for a fresh trace — what batch-level work (a device
+    dispatch serving many riders) runs under, with a ``links=[...]``
+    attribute on its spans fanning it into each rider's trace. None with
+    tracing off."""
+    if not _enabled:
+        return None
+    tid = _next_id()
+    return SpanContext(tid, tid)
 
 
 class _Attach:
@@ -239,7 +281,15 @@ def _finish(name: str, start_s: float, end_s: float, ctx: SpanContext,
     if parent is not None:
         args["parent_id"] = parent.span_id
     for k, v in attrs.items():
-        args[k] = v if isinstance(v, (int, float, bool, str)) else repr(v)
+        if isinstance(v, (int, float, bool, str)):
+            args[k] = v
+        elif isinstance(v, (list, tuple)) and all(
+                isinstance(i, (int, float, bool, str)) for i in v):
+            # link lists (rider request ids on batch spans) stay
+            # structured: spans_for_trace matches against them
+            args[k] = list(v)
+        else:
+            args[k] = repr(v)
     _events.append({
         "name": name,
         "ph": "X",
@@ -250,25 +300,82 @@ def _finish(name: str, start_s: float, end_s: float, ctx: SpanContext,
         "args": args,
     })
     observe_stage(name, dur)
+    # every span completion is also a flight-recorder event (ISSUE 9) —
+    # in the recorder's DEDICATED span ring, so high-rate span traffic
+    # can never evict the sparse reliability events postmortems need
+    from sparkdl_tpu.observability import flight
+
+    flight.flight_recorder().record_span_event(
+        name, trace_id=ctx.trace_id, span_id=ctx.span_id,
+        dur_ms=round(dur * 1e3, 3),
+    )
 
 
 def trace_events() -> "list[dict]":
-    """The finished-span ring as plain dicts (test/inspection hook)."""
-    return list(_events)
+    """The finished-span ring as plain dicts (test/inspection hook).
+
+    Copied via the shared hot-append-safe snapshot (a postmortem dump
+    taken under load must get the ring, not a RuntimeError from a
+    concurrent span finish)."""
+    from sparkdl_tpu.observability.flight import safe_ring_snapshot
+
+    return safe_ring_snapshot(_events)
+
+
+def spans_for_trace(trace_id: int, *, follow_links: bool = True,
+                    events: "list[dict] | None" = None) -> "list[dict]":
+    """Every finished span of one trace, timestamp-ordered.
+
+    A request's trace id is its request id (:func:`next_request_id`), so
+    ``spans_for_trace(fut.request_id)`` answers "what happened to THIS
+    request". Matching is two-level: spans whose ``trace_id`` equals (or
+    whose ``links`` list contains) the id are direct members; with
+    ``follow_links`` (default) the batch traces those linked spans
+    belong to are pulled in whole — the device dispatch, replica
+    execution and fetch spans a rider shared with its batch-mates.
+    ``events`` lets a caller resolving MANY traces (a postmortem dump)
+    snapshot the ring once instead of per call.
+    """
+    evs = events if events is not None else trace_events()
+    picked: "list[dict]" = []
+    span_ids: "set" = set()
+    related: "set" = set()
+    for ev in evs:
+        args = ev.get("args", {})
+        links = args.get("links")
+        if args.get("trace_id") == trace_id or (
+                isinstance(links, list) and trace_id in links):
+            picked.append(ev)
+            span_ids.add(args.get("span_id"))
+            related.add(args.get("trace_id"))
+    related.discard(trace_id)
+    if follow_links and related:
+        for ev in evs:
+            args = ev.get("args", {})
+            if (args.get("trace_id") in related
+                    and args.get("span_id") not in span_ids):
+                picked.append(ev)
+                span_ids.add(args.get("span_id"))
+    picked.sort(key=lambda e: e["ts"])
+    return picked
 
 
 def clear_trace() -> None:
     _events.clear()
 
 
-def export_chrome_trace(path: "str | os.PathLike") -> int:
+def export_chrome_trace(path: "str | os.PathLike",
+                        trace_id: "int | None" = None) -> int:
     """Write the collected spans as Chrome ``trace_event`` JSON.
 
     The file loads in ``chrome://tracing`` and https://ui.perfetto.dev —
     same UIs that read ``jax.profiler`` captures, so serving spans and
-    XLA device traces can sit side by side. Returns the event count.
+    XLA device traces can sit side by side. ``trace_id`` (e.g. a
+    request id) exports only that trace (linked batch spans included).
+    Returns the event count.
     """
-    events = trace_events()
+    events = (trace_events() if trace_id is None
+              else spans_for_trace(trace_id))
     with open(path, "w") as f:
         json.dump(
             {"traceEvents": events, "displayTimeUnit": "ms"}, f,

@@ -49,6 +49,7 @@ import random
 import threading
 from typing import Iterator
 
+from sparkdl_tpu.observability import flight
 from sparkdl_tpu.observability.registry import registry
 
 __all__ = [
@@ -231,6 +232,12 @@ class FaultPlan:
                     break
         if fire is not None:
             _injected_counter().inc(site=site)
+            # flight ring first (ISSUE 9): a postmortem triggered by the
+            # failure this injection causes must contain its cause
+            flight.record_event(
+                "fault.injected", site=site, hit=n,
+                error=fire.exc_type.__name__,
+            )
             raise fire._make(n)
 
     def snapshot(self) -> dict:

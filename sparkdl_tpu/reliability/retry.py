@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from sparkdl_tpu.observability import flight
 from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.observability.tracing import span
 
@@ -68,8 +69,11 @@ def _retries_counter():
 def record_retry(site: str, outcome: str) -> None:
     """Record one retry outcome into the spine — shared with callers
     that implement their own recovery loop (ReplicaPool re-routes,
-    checkpoint fallback) so every second chance lands in ONE metric."""
+    checkpoint fallback) so every second chance lands in ONE metric.
+    Each outcome also lands in the flight ring: a postmortem shows the
+    retry storm that preceded the trigger, not just its count."""
     _retries_counter().inc(site=site, outcome=outcome)
+    flight.record_event("retry", site=site, outcome=outcome)
 
 
 class RetryExhaustedError(RuntimeError):

@@ -26,6 +26,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Callable, Iterable
 
+from sparkdl_tpu.observability import flight
 from sparkdl_tpu.reliability.retry import RetryPolicy
 
 __all__ = ["resumable_finetune"]
@@ -102,6 +103,13 @@ def resumable_finetune(
     def attempt():
         attempts["n"] += 1
         if attempts["n"] > 1:
+            # the resume is a flight event: a postmortem shows the crash
+            # -> restore -> replay chain, not just the final history
+            flight.record_event(
+                "supervisor.resume", attempt=attempts["n"],
+                checkpoint_dir=str(checkpoint_dir),
+                resumed_steps=len(entries),
+            )
             _log.warning(
                 "resumable_finetune: attempt %d resuming from %s",
                 attempts["n"], checkpoint_dir,

@@ -32,6 +32,8 @@ from typing import Any, Optional
 
 import numpy as np
 
+from sparkdl_tpu.observability import slo as slo_mod
+from sparkdl_tpu.observability import tracing
 from sparkdl_tpu.observability.tracing import span
 from sparkdl_tpu.runtime.completion import start_fetch
 from sparkdl_tpu.runtime.dispatch import ChainPolicy, record_dispatch
@@ -95,6 +97,7 @@ class ContinuousGPTEngine:
                  idle_wait_s: float = 0.005,
                  chain_tokens: "int | None" = 1,
                  metrics: ServingMetrics | None = None,
+                 slo: "slo_mod.SLO | None" = None,
                  auto_start: bool = True):
         import jax
         import jax.numpy as jnp
@@ -222,6 +225,14 @@ class ContinuousGPTEngine:
         self._scatter_fn = _scatter
         self._step_fn = _step
         self._step_chain_fn = _step_chain
+        # process-wide registrations go LAST: a constructor failure above
+        # (bad config, cache init OOM) must not leak a tracker/provider
+        # bound to a half-built engine
+        from sparkdl_tpu.serving.metrics import EngineObservability
+
+        self._obs = EngineObservability(
+            "continuous", self._flight_context, slo=slo, n_slots=n_slots)
+        self.slo_tracker = self._obs.tracker
         if auto_start:
             self.start()
 
@@ -281,6 +292,7 @@ class ContinuousGPTEngine:
         self.queue.fail_pending()
         with self._lock:
             self._fail_inflight(EngineClosedError("engine shut down"))
+        self._obs.close(drain=drain)
 
     def _loop(self) -> None:
         try:
@@ -327,8 +339,12 @@ class ContinuousGPTEngine:
                         # and the loop keeps serving
                         free.insert(0, slot)
                         if not req.future.done():
+                            self._record_request_span(
+                                req, time.monotonic(), ok=False,
+                                tokens=0, error=e)
                             req.future.set_exception(e)
-                            record_request_failure(e)
+                            record_request_failure(
+                                e, request_id=req.request_id)
                             self.metrics.record_request(
                                 now - req.enqueued, ok=False
                             )
@@ -347,7 +363,8 @@ class ContinuousGPTEngine:
         gen: GenRequest = req.payload
         lp = pick_bucket(len(gen.prompt), self._len_buckets)
         with span("serving.prefill", parent=req.trace_ctx,
-                  prompt_len=len(gen.prompt), bucket=lp, slot=slot):
+                  prompt_len=len(gen.prompt), bucket=lp, slot=slot,
+                  request_id=req.request_id):
             ids = np.zeros((1, lp), np.int32)
             mask = np.zeros((1, lp), np.int32)
             ids[0, lp - len(gen.prompt):] = gen.prompt
@@ -404,8 +421,12 @@ class ContinuousGPTEngine:
 
         k = self._decode_chain_len(time.monotonic())
         t0 = time.perf_counter()
+        # decode ticks are batch-level: their spans link every rider's
+        # request id so each request's trace pulls in its decode steps
+        links = ([f.req.request_id for f in self._inflight.values()]
+                 if tracing.tracing_enabled() else ())
         with span("serving.decode_step", slots=len(self._inflight),
-                  chain=k):
+                  chain=k, links=links):
             # Async token readback (runtime/completion.py): the D2H copy
             # of the token ids is enqueued the moment the decode dispatch
             # is — it rides behind the compute instead of waiting for the
@@ -456,13 +477,26 @@ class ContinuousGPTEngine:
                 or (self.eos_id is not None
                     and flight.produced[-1] == self.eos_id))
 
+    def _record_request_span(self, req: Request, now: float, *,
+                             ok: bool, tokens: int,
+                             error: "Exception | None" = None) -> None:
+        if tracing.tracing_enabled():
+            tracing.record_span(
+                "serving.request", req.enqueued, now,
+                parent=req.trace_ctx, request_id=req.request_id,
+                ok=ok, tokens=tokens,
+                **({"error": type(error).__name__} if error else {}),
+            )
+
     def _complete(self, slot: int) -> None:
         flight = self._inflight.pop(slot)
-        latency = time.monotonic() - flight.req.enqueued
+        now = time.monotonic()
+        self._record_request_span(
+            flight.req, now, ok=True, tokens=len(flight.produced))
         flight.req.future.set_result(
             np.asarray(flight.produced, np.int32)
         )
-        self.metrics.record_request(latency, ok=True)
+        self.metrics.record_request(now - flight.req.enqueued, ok=True)
 
     def _expire_inflight(self, now: float) -> None:
         for slot in list(self._inflight):
@@ -473,8 +507,12 @@ class ContinuousGPTEngine:
                     "deadline exceeded mid-decode "
                     f"({len(flight.produced)}/{flight.max_new} tokens)"
                 )
+                self._record_request_span(
+                    flight.req, now, ok=False,
+                    tokens=len(flight.produced), error=exc)
                 flight.req.future.set_exception(exc)
-                record_request_failure(exc)
+                record_request_failure(
+                    exc, request_id=flight.req.request_id)
                 self.metrics.record_request(
                     now - flight.req.enqueued, ok=False
                 )
@@ -483,10 +521,15 @@ class ContinuousGPTEngine:
         for slot in list(self._inflight):
             flight = self._inflight.pop(slot)
             if not flight.req.future.done():
+                now = time.monotonic()
+                self._record_request_span(
+                    flight.req, now, ok=False,
+                    tokens=len(flight.produced), error=exc)
                 flight.req.future.set_exception(exc)
-                record_request_failure(exc)
+                record_request_failure(
+                    exc, request_id=flight.req.request_id)
                 self.metrics.record_request(
-                    time.monotonic() - flight.req.enqueued, ok=False
+                    now - flight.req.enqueued, ok=False
                 )
 
     # -- introspection -------------------------------------------------------
@@ -494,10 +537,35 @@ class ContinuousGPTEngine:
     def active_slots(self) -> int:
         return len(self._inflight)
 
+    def trace(self, request_id: int) -> "list[dict]":
+        """Every finished span of one request's trace (queue wait,
+        prefill, its decode-step dispatches via links, the terminal
+        ``serving.request``). Empty with tracing off."""
+        return tracing.spans_for_trace(request_id)
+
+    def inflight_request_ids(self) -> "list[int]":
+        """Ids of queued + decoding requests (postmortem input).
+        Best-effort: read without the engine lock."""
+        out = self.queue.pending_request_ids()
+        try:
+            out.extend(f.req.request_id
+                       for f in list(self._inflight.values()))
+        except RuntimeError:  # pragma: no cover - mutation race
+            pass
+        return out
+
+    def _flight_context(self) -> dict:
+        out = self.metrics.snapshot(self.queue)
+        out["active_slots"] = self.active_slots
+        out["inflight_request_ids"] = self.inflight_request_ids()
+        return out
+
     def snapshot(self) -> dict[str, Any]:
         out = self.metrics.snapshot(self.queue)
         out["active_slots"] = self.active_slots
         out["n_slots"] = self.n_slots
+        out["slo"] = (self.slo_tracker.sample()
+                      if self.slo_tracker is not None else None)
         return out
 
     def __enter__(self) -> "ContinuousGPTEngine":

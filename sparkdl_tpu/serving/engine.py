@@ -14,6 +14,15 @@ over a :class:`~sparkdl_tpu.serving.replicas.ReplicaPool` of per-device
 executors); overload rejects at admission (QueueFullError), deadlines
 cancel mid-queue (DeadlineExceededError), and ``close(drain=True)``
 serves every admitted request before stopping.
+
+Observability (ISSUE 9): every submit allocates a request id
+(``fut.request_id``); with ``SPARKDL_TPU_TRACE=1`` the request's full
+span set replays via :meth:`ServingEngine.trace`. Pass ``slo=`` to
+declare latency/availability objectives — rolling error-budget burn then
+rides ``snapshot()["slo"]``, the ``sparkdl_slo_*`` gauges, and the
+exporter's ``/slo.json``. The engine also registers itself with the
+flight recorder, so reliability-triggered postmortem bundles carry its
+queue state and in-flight request traces.
 """
 
 from __future__ import annotations
@@ -23,8 +32,10 @@ from typing import Any, Callable
 
 import numpy as np
 
+from sparkdl_tpu.observability import slo as slo_mod
+from sparkdl_tpu.observability import tracing
 from sparkdl_tpu.observability.exporters import maybe_start_metrics_server
-from sparkdl_tpu.serving.metrics import ServingMetrics
+from sparkdl_tpu.serving.metrics import EngineObservability, ServingMetrics
 from sparkdl_tpu.serving.microbatcher import MicroBatcher
 from sparkdl_tpu.serving.queue import RequestQueue
 from sparkdl_tpu.transformers._inference import BatchedRunner
@@ -38,13 +49,17 @@ class ServingEngine:
     ``max_wait_s`` bounds the extra latency the FIRST request of a batch
     pays to pick up riders; ``max_queue_depth`` bounds host memory and
     turns overload into fast rejects instead of unbounded tail latency.
+    ``slo`` (an :class:`~sparkdl_tpu.observability.slo.SLO`) declares
+    this engine's objectives; the tracker it creates lives on
+    ``self.slo_tracker`` and is unregistered at close.
     """
 
     def __init__(self, runner: "BatchedRunner | Any", *,
                  max_queue_depth: int = 256,
                  max_wait_s: float = 0.005,
                  extract: Callable[[Any], dict[str, np.ndarray]] | None = None,
-                 metrics: ServingMetrics | None = None):
+                 metrics: ServingMetrics | None = None,
+                 slo: "slo_mod.SLO | None" = None):
         # Opt-in observability endpoint (SPARKDL_TPU_METRICS_PORT):
         # idempotent, so every engine in the process shares one server.
         maybe_start_metrics_server()
@@ -55,25 +70,59 @@ class ServingEngine:
             self.queue, runner, max_wait_s=max_wait_s, extract=extract,
             metrics=self.metrics,
         ).start()
+        # process-wide registrations go LAST: a constructor failure above
+        # must not leak a tracker/provider bound to a half-built engine
+        self._obs = EngineObservability(
+            "engine", self._flight_context, slo=slo,
+            max_queue_depth=max_queue_depth,
+        )
+        self.slo_tracker = self._obs.tracker
 
     def submit(self, payload: Any, *,
                timeout_s: float | None = None) -> Future:
         """Admit one request (a feature dict of per-row arrays, or
         whatever ``extract`` eats). Returns a Future resolving to the
-        output row; raises QueueFullError / EngineClosedError at the
-        door."""
+        output row (carrying ``request_id``); raises QueueFullError /
+        EngineClosedError at the door."""
         return self.queue.submit(payload, timeout_s=timeout_s)
+
+    def trace(self, request_id: int) -> "list[dict]":
+        """Every finished span of one request's trace (queue wait, batch
+        assembly/dispatch via links, replica execution, the terminal
+        ``serving.request``), timestamp-ordered. Empty with tracing off —
+        enable with ``SPARKDL_TPU_TRACE=1`` or
+        :func:`~sparkdl_tpu.observability.tracing.enable_tracing`.
+        Export for Perfetto with
+        ``tracing.export_chrome_trace(path, trace_id=request_id)``."""
+        return tracing.spans_for_trace(request_id)
+
+    def inflight_request_ids(self) -> "list[int]":
+        """Ids of every admitted-but-unresolved request (queued +
+        dispatched) — what a postmortem bundle resolves to traces."""
+        return (self.queue.pending_request_ids()
+                + self.batcher.inflight_request_ids())
 
     def close(self, *, drain: bool = True,
               timeout_s: float | None = 30.0) -> None:
         self.batcher.shutdown(drain=drain, timeout_s=timeout_s)
+        self._obs.close(drain=drain)
+
+    def _flight_context(self) -> dict:
+        """The engine's contribution to flight-recorder postmortems."""
+        out = self.metrics.snapshot(self.queue)
+        out["inflight_request_ids"] = self.inflight_request_ids()
+        if self.slo_tracker is not None:
+            out["slo"] = self.slo_tracker.sample()
+        return out
 
     def snapshot(self) -> dict:
         """Operator metrics: queue depth, occupancy, latency p50/p95/p99,
         admission counters — plus per-replica depth/in-flight/quarantine
-        state when the runner is a ReplicaPool, and the process-wide
+        state when the runner is a ReplicaPool, the process-wide
         shed-load breakdown (``requests_failed_by_reason``, from the
-        reliability layer's ``sparkdl_requests_failed_total`` counter)."""
+        reliability layer's ``sparkdl_requests_failed_total`` counter),
+        and rolling SLO compliance/burn under ``slo`` when objectives
+        were declared."""
         snap = self.metrics.snapshot(self.queue)
         pool_snapshot = getattr(self.runner, "snapshot", None)
         if callable(pool_snapshot):
@@ -86,6 +135,8 @@ class ServingEngine:
         snap["requests_failed_by_reason"] = (
             fam.labelled_values("reason") if fam else {}
         )
+        snap["slo"] = (self.slo_tracker.sample()
+                       if self.slo_tracker is not None else None)
         return snap
 
     def __enter__(self) -> "ServingEngine":

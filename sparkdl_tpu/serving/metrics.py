@@ -11,6 +11,8 @@ from __future__ import annotations
 import threading
 from typing import Any
 
+from sparkdl_tpu.observability import flight
+from sparkdl_tpu.observability import slo as slo_mod
 from sparkdl_tpu.observability.metrics import StepMeter
 from sparkdl_tpu.observability.registry import PERCENT_BUCKETS, registry
 
@@ -28,6 +30,38 @@ _M_BATCHES = registry().counter(
 _M_OCCUPANCY = registry().histogram(
     "sparkdl_serving_batch_occupancy_pct",
     "live rows per dispatch as % of capacity", buckets=PERCENT_BUCKETS)
+
+
+class EngineObservability:
+    """The process-wide registrations every serving engine shares
+    (ISSUE 9): an optional SLO tracker, a flight-recorder context
+    provider, and engine.start/engine.close lifecycle events. One
+    implementation so ServingEngine and ContinuousGPTEngine cannot
+    drift. Construct LAST in the engine's ``__init__`` (a constructor
+    failure must not leak registrations) and :meth:`close` on engine
+    close (idempotent)."""
+
+    def __init__(self, kind: str, context_fn, *,
+                 slo: "slo_mod.SLO | None" = None, **start_fields):
+        self.tracker = (
+            slo_mod.register(slo_mod.SLOTracker(slo))
+            if slo is not None else None
+        )
+        self.name = flight.add_context_provider(
+            f"{kind}-{id(context_fn.__self__):x}", context_fn
+        )
+        self._closed = False
+        flight.record_event("engine.start", engine=self.name,
+                            **start_fields)
+
+    def close(self, *, drain: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        flight.record_event("engine.close", engine=self.name, drain=drain)
+        flight.remove_context_provider(self.name)
+        if self.tracker is not None:
+            slo_mod.unregister(self.tracker)
 
 
 class ServingMetrics:

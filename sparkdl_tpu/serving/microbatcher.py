@@ -174,20 +174,22 @@ class MicroBatcher:
             self._resolve_pending(0)
 
     def _dispatch(self, reqs: list[Request]) -> None:
-        # The worker thread has no ambient span; re-root on the first
-        # rider's submit-side context so batch-assembly and device-step
-        # spans land in a caller's trace (cross-thread contextvar hop).
-        batch_ctx = next(
-            (r.trace_ctx for r in reqs if r.trace_ctx is not None), None
-        )
+        # Batch-level work (one device dispatch, many riders) runs in
+        # its OWN trace; the riders' request ids ride every batch span
+        # as a `links` list, fanning the batch into each rider's trace
+        # (ISSUE 9: spans_for_trace follows the links both ways).
+        batch_ctx = tracing.new_trace_context()  # None with tracing off
         with tracing.attach(batch_ctx):
             self._dispatch_traced(reqs, batch_ctx)
 
     def _dispatch_traced(self, reqs: list[Request],
                          batch_ctx) -> None:
+        links = ([r.request_id for r in reqs]
+                 if batch_ctx is not None else ())
         feeds: list[dict[str, np.ndarray]] = []
         live: list[Request] = []
-        with span("serving.batch_assemble", requests=len(reqs)):
+        with span("serving.batch_assemble", requests=len(reqs),
+                  links=links):
             for req in reqs:
                 feed, err = (try_extract(self.extract, req.payload)
                              if self.extract is not None
@@ -272,13 +274,35 @@ class MicroBatcher:
                 if not req.future.done():
                     self._finish(req, error=exc)
 
+    def inflight_request_ids(self) -> "list[int]":
+        """Request ids of dispatched-but-unresolved batches (postmortem
+        input). Best-effort: the loop thread mutates ``_pending``
+        concurrently, and a postmortem must never crash serving."""
+        out: "list[int]" = []
+        try:
+            for live, _feeds, _fut, _ctx in list(self._pending):
+                out.extend(r.request_id for r in live)
+        except RuntimeError:  # pragma: no cover - mutation race
+            pass
+        return out
+
     def _finish(self, req: Request, *, result: Any = None,
                 error: Exception | None = None) -> None:
-        latency = time.monotonic() - req.enqueued
+        now = time.monotonic()
+        latency = now - req.enqueued
+        if tracing.tracing_enabled():
+            # the request's terminal span: submit -> resolution, rooted
+            # on its own trace (the full lifetime, queue wait included)
+            tracing.record_span(
+                "serving.request", req.enqueued, now,
+                parent=req.trace_ctx, request_id=req.request_id,
+                ok=error is None,
+                **({"error": type(error).__name__} if error else {}),
+            )
         if error is not None:
             # shed load must be observable: every accepted-then-failed
             # request lands in the reason-labelled registry counter
-            record_request_failure(error)
+            record_request_failure(error, request_id=req.request_id)
             req.future.set_exception(error)
         else:
             req.future.set_result(result)

@@ -21,7 +21,7 @@ import time
 from concurrent.futures import Future
 from typing import Any
 
-from sparkdl_tpu.observability import tracing
+from sparkdl_tpu.observability import flight, tracing
 from sparkdl_tpu.observability.registry import registry
 
 # Registry mirrors of the queue's own counters (ISSUE 2: the spine sees
@@ -77,26 +77,42 @@ def failure_reason(exc: BaseException) -> str:
     return "error"
 
 
-def record_request_failure(exc: BaseException) -> None:
+def record_request_failure(exc: BaseException,
+                           request_id: "int | None" = None) -> None:
     """Land one failed-request outcome in the registry
-    (``sparkdl_requests_failed_total{reason=...}``) so shed load is
-    observable — called by every path that fails an accepted request's
-    Future (queue sweeps, drains, and the micro-batcher)."""
-    _M_FAILED.inc(reason=failure_reason(exc))
+    (``sparkdl_requests_failed_total{reason=...}``) and the flight
+    recorder so shed load is observable — called by every path that
+    fails an accepted request's Future (queue sweeps, drains, and the
+    micro-batcher)."""
+    reason = failure_reason(exc)
+    _M_FAILED.inc(reason=reason)
+    flight.record_event(
+        "request.failed", reason=reason, error=type(exc).__name__,
+        request_id=request_id,
+    )
 
 
 @dataclasses.dataclass
 class Request:
     """One queued unit of work. ``deadline`` is absolute ``time.monotonic``
     seconds (None = no deadline); ``enqueued`` stamps queue-wait metrics.
-    ``trace_ctx`` carries the submitter's span context across the thread
-    boundary so queue-wait and device-step spans hang off its trace."""
+    ``request_id`` is the process-unique id submit allocated (also the
+    caller-visible ``future.request_id`` and, with tracing on, the
+    request's trace id); ``trace_ctx`` is the root span context of that
+    trace (None with tracing off — the id is the only per-request cost),
+    carried across thread boundaries so every stage span of this request
+    lands in its trace."""
 
     payload: Any
     future: Future
     deadline: float | None
     enqueued: float
     trace_ctx: "tracing.SpanContext | None" = None
+    request_id: int = 0
+    #: the submitter's ambient span at submit time (None with tracing
+    #: off or a span-less caller): its trace id rides the queue-wait
+    #: span's links, joining the caller's trace to the request's
+    submitter_ctx: "tracing.SpanContext | None" = None
 
     def expired(self, now: float | None = None) -> bool:
         return (self.deadline is not None
@@ -110,7 +126,7 @@ class Request:
                 f"deadline exceeded after "
                 f"{time.monotonic() - self.enqueued:.3f}s in queue"
             )
-            record_request_failure(exc)
+            record_request_failure(exc, request_id=self.request_id)
             self.future.set_exception(exc)
 
 
@@ -160,6 +176,12 @@ class RequestQueue:
     def depth(self) -> int:
         return len(self._dq)
 
+    def pending_request_ids(self) -> "list[int]":
+        """Request ids currently queued (flight-recorder postmortems
+        resolve these to in-flight traces)."""
+        with self._cv:
+            return [r.request_id for r in self._dq]
+
     @property
     def closed(self) -> bool:
         return self._closed
@@ -174,9 +196,15 @@ class RequestQueue:
         the queue's condition lock, so a submit either wins the race (its
         request was accepted and WILL be drained — ``close()`` keeps
         queued work takeable) or raises ``EngineClosedError`` — never a
-        silently dropped Future (pinned by tests)."""
+        silently dropped Future (pinned by tests).
+
+        The returned Future carries ``request_id`` — the process-unique
+        id that doubles as the request's trace id
+        (``ServingEngine.trace(fut.request_id)`` replays its spans when
+        tracing is on)."""
         now = time.monotonic()
         deadline = now + timeout_s if timeout_s is not None else None
+        rid = tracing.next_request_id()
         with self._cv:
             if self._closed:
                 raise EngineClosedError("queue is closed to new requests")
@@ -190,9 +218,12 @@ class RequestQueue:
                     "backoff or raise capacity"
                 )
             fut: Future = Future()
+            fut.request_id = rid
             self._dq.append(Request(
                 payload, fut, deadline, now,
-                trace_ctx=tracing.current_context(),
+                trace_ctx=tracing.request_context(rid),
+                request_id=rid,
+                submitter_ctx=tracing.current_context(),
             ))
             self.submitted += 1
             _M_SUBMITTED.inc()
@@ -239,10 +270,15 @@ class RequestQueue:
         for req in out:
             _M_WAIT.observe(now - req.enqueued)
             # retroactive span: the wait started at submit, long before
-            # this instrumentation point, parented on the submitter
+            # this instrumentation point, parented on the request's
+            # root; the submitter's trace rides the links so a caller's
+            # own span ("client_call") still reaches the request trace
+            # via spans_for_trace(caller_trace_id)
+            sub = req.submitter_ctx
             tracing.record_span(
                 "serving.queue_wait", req.enqueued, now,
-                parent=req.trace_ctx,
+                parent=req.trace_ctx, request_id=req.request_id,
+                **({"links": [sub.trace_id]} if sub is not None else {}),
             )
         return out
 
@@ -265,7 +301,7 @@ class RequestQueue:
             while self._dq:
                 req = self._dq.popleft()
                 if req.future.set_running_or_notify_cancel():
-                    record_request_failure(exc)
+                    record_request_failure(exc, request_id=req.request_id)
                     req.future.set_exception(exc)
                 else:
                     self.cancelled += 1

@@ -62,9 +62,10 @@ from typing import Any, Callable, NamedTuple
 
 import numpy as np
 
+from sparkdl_tpu.observability import flight
 from sparkdl_tpu.observability.metrics import StepMeter
 from sparkdl_tpu.observability.registry import registry
-from sparkdl_tpu.observability.tracing import span
+from sparkdl_tpu.observability.tracing import attach, current_context, span
 from sparkdl_tpu.reliability.faults import fault_point
 from sparkdl_tpu.reliability.retry import record_retry
 from sparkdl_tpu.transformers._inference import BatchedRunner
@@ -140,10 +141,13 @@ class _Work:
     """
 
     __slots__ = ("arrays", "result", "exc", "done", "retries", "probe",
-                 "reroutable", "owner", "started_at", "_lock")
+                 "reroutable", "owner", "started_at", "trace_ctx", "_lock")
 
     def __init__(self, arrays: dict[str, np.ndarray]):
         self.arrays = arrays
+        #: the dispatching batch's trace context (captured at submit so
+        #: the replica worker's spans land in the riders' linked trace)
+        self.trace_ctx = None
         self.result: Any = None
         self.exc: "BaseException | None" = None
         self.done = threading.Event()
@@ -250,7 +254,11 @@ class _Replica:
             exc: "Exception | None" = None
             result = None
             try:
-                with span("serving.replica_batch", replica=self.index):
+                # re-root on the batch's trace so the replica span (and
+                # the runner's device_step span under it) land in the
+                # riders' linked trace
+                with attach(work.trace_ctx), \
+                        span("serving.replica_batch", replica=self.index):
                     fault_point("replica.execute")
                     result = self.runner.run_batch(work.arrays)
             except BaseException as e:
@@ -381,6 +389,15 @@ class ReplicaPool:
             for i in range(n_replicas)
         ]
         self._worker_ids = {r.thread.ident: r for r in self.replicas}
+        # postmortem bundles + /healthz read live quarantine state from
+        # this provider (removed at close)
+        self._flight_name = flight.add_context_provider(
+            f"pool-{id(self):x}", self.snapshot
+        )
+        flight.record_event(
+            "pool.start", pool=self._flight_name,
+            replicas=len(self.replicas),
+        )
         self._watchdog: "threading.Thread | None" = None
         if dispatch_timeout_s is not None:
             self._watchdog = threading.Thread(
@@ -404,6 +421,7 @@ class ReplicaPool:
         """Route one assembled micro-batch; returns a future resolving
         to the same output ``BatchedRunner.run_batch`` produces."""
         work = _Work(arrays)
+        work.trace_ctx = current_context()  # None with tracing off
         self._route(work)
         return _PoolFuture(work)
 
@@ -420,13 +438,21 @@ class ReplicaPool:
     # -- routing -------------------------------------------------------------
     def _route(self, work: _Work, exclude: "_Replica | None" = None) -> None:
         depth = _metrics().depth
-        with self._lock:
-            if self._closed:
-                raise RuntimeError("ReplicaPool is closed")
-            replica = self._pick_locked(work, exclude)
-            replica.outstanding += 1
-            work.owner = replica
-            depth.set(replica.outstanding, replica=str(replica.index))
+        try:
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("ReplicaPool is closed")
+                replica = self._pick_locked(work, exclude)
+                replica.outstanding += 1
+                work.owner = replica
+                depth.set(replica.outstanding, replica=str(replica.index))
+        except AllReplicasQuarantinedError:
+            # outside the pool lock: the dump's context providers call
+            # snapshot(), which takes it again
+            flight.record_event(
+                "pool.all_quarantined", replicas=len(self.replicas))
+            flight.trigger_dump("all_replicas_quarantined")
+            raise
         replica.queue.put(work)
 
     def _pick_locked(self, work: _Work,
@@ -501,6 +527,8 @@ class ReplicaPool:
                 rejoined = True
         if rejoined:
             _metrics().reintegrated.inc()
+            flight.record_event(
+                "replica.reintegrated", replica=replica.index)
             _log.info(
                 "replica %d (%s) reintegrated after successful probe; "
                 "%d healthy replica(s)",
@@ -534,6 +562,7 @@ class ReplicaPool:
                         now + replica.probation_backoff_s)
             was_probe = work.probe and replica.quarantined
             replica.probing = False
+            probe_failed = False
             if was_probe:
                 # failed probe: stay quarantined, back off exponentially
                 replica.probation_backoff_s = min(
@@ -541,6 +570,7 @@ class ReplicaPool:
                     self.probation_max_s,
                 )
                 replica.probation_until = now + replica.probation_backoff_s
+                probe_failed = True
                 _log.warning(
                     "replica %d probation probe failed; next probe in "
                     "%.2fs", replica.index, replica.probation_backoff_s,
@@ -554,8 +584,23 @@ class ReplicaPool:
                         replica.probation_backoff_s = self.probation_s
                         replica.probation_until = now + self.probation_s
                     quarantined_now = True
+        if probe_failed:
+            flight.record_event(
+                "replica.probe_failed", replica=replica.index,
+                next_probe_s=round(replica.probation_backoff_s, 3),
+                error=type(exc).__name__,
+            )
         if quarantined_now:
             _metrics().quarantined.inc()
+            # the flight event + postmortem trigger sit OUTSIDE the pool
+            # lock (the dump's providers re-take it via snapshot())
+            flight.record_event(
+                "replica.quarantined", replica=replica.index,
+                failures=replica.consecutive_failures,
+                error=type(exc).__name__,
+            )
+            flight.trigger_dump(
+                "replica_quarantined", replica=replica.index)
             _log.error(
                 "replica %d (%s) quarantined after %d consecutive "
                 "failures; pool continues on %d healthy replica(s)%s",
@@ -608,6 +653,11 @@ class ReplicaPool:
                 "probation probe this batch rode also failed"
             )
             pool_err.__cause__ = exc
+            flight.record_event(
+                "pool.all_quarantined", replicas=len(self.replicas),
+                probe_failed=True,
+            )
+            flight.trigger_dump("all_replicas_quarantined")
             work.fail(pool_err)
             return
         work.fail(exc)
@@ -676,6 +726,11 @@ class ReplicaPool:
                 _metrics().hung.inc()
                 if not already:
                     _metrics().quarantined.inc()
+                flight.record_event(
+                    "replica.hung", replica=r.index,
+                    timeout_s=self.dispatch_timeout_s,
+                )
+                flight.trigger_dump("hung_dispatch", replica=r.index)
                 _log.error(
                     "watchdog: dispatch on replica %d exceeded %.2fs; "
                     "re-routing the batch and quarantining the replica "
@@ -701,6 +756,9 @@ class ReplicaPool:
             if self._closed:
                 return
             self._closed = True
+        flight.record_event(
+            "pool.close", pool=self._flight_name, drain=drain)
+        flight.remove_context_provider(self._flight_name)
         self._closing.set()
         for r in self.replicas:
             if not drain:

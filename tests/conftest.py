@@ -29,6 +29,34 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def fail_on_duplicate_test_basenames(root):
+    """tests/ has no ``__init__.py``, so pytest imports each test file as
+    a top-level module named after its BASENAME — two ``test_pipeline.py``
+    in different subdirs collide and collection silently drops (or
+    errors on) one of them (bit PR 8). Fail the whole run loudly
+    instead, at conftest import, before any test collects."""
+    import pathlib
+
+    seen: "dict[str, list]" = {}
+    for path in sorted(pathlib.Path(root).rglob("test_*.py")):
+        seen.setdefault(path.name, []).append(path)
+    dups = {name: paths for name, paths in seen.items() if len(paths) > 1}
+    if dups:
+        detail = "; ".join(
+            f"{name}: "
+            + ", ".join(str(p.relative_to(root)) for p in paths)
+            for name, paths in sorted(dups.items())
+        )
+        raise pytest.UsageError(
+            "duplicate test-file basenames under tests/ (no __init__.py "
+            "-> module names collide and pytest drops files): " + detail
+            + " — rename one of each pair (e.g. test_<subdir>_<name>.py)"
+        )
+
+
+fail_on_duplicate_test_basenames(os.path.dirname(os.path.abspath(__file__)))
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,137 @@
+"""Concurrent exporter scrapes: /metrics + /metrics.json + /slo.json +
+/healthz hammered from threads while serving-style mutation runs — no
+torn output, no exceptions, every response parseable (ISSUE 9)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from sparkdl_tpu.observability import flight, slo
+from sparkdl_tpu.observability.exporters import MetricsServer
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.observability.slo import SLO, SLOTracker
+
+
+@pytest.fixture
+def server():
+    srv = MetricsServer(port=0)
+    try:
+        yield srv
+    finally:
+        srv.close()
+
+
+def _get(port, path, timeout=10):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:  # 503 from /healthz is a payload
+        return e.code, e.read().decode()
+
+
+def test_concurrent_scrapes_against_mutation(server):
+    stop = threading.Event()
+    errors: "list[BaseException]" = []
+
+    counter = registry().counter(
+        "sparkdl_scrape_torture_total", "scrape torture", labels=("k",))
+    hist = registry().histogram(
+        "sparkdl_scrape_torture_seconds", "scrape torture")
+    tracker = slo.register(SLOTracker(SLO(
+        name="scrape-torture", latency_threshold_s=0.1)))
+    provider = flight.add_context_provider(
+        "scrape-torture", lambda: {"replica_count": 2, "healthy_count": 2,
+                                   "inflight_request_ids": [1, 2]})
+
+    def mutate(seed):
+        i = 0
+        try:
+            while not stop.is_set():
+                counter.inc(k=str((seed + i) % 5))
+                hist.observe(0.001 * (i % 7))
+                flight.record_event("torture", i=i)
+                if i % 50 == 0:
+                    # trackers churn while /slo.json lists them
+                    t = slo.register(SLOTracker(SLO(
+                        name=f"churn-{seed}", latency_threshold_s=0.1)))
+                    slo.unregister(t)
+                i += 1
+        except BaseException as e:  # pragma: no cover - failure capture
+            errors.append(e)
+
+    checks = {
+        "/metrics": lambda s, b: s == 200 and "# TYPE" in b,
+        "/metrics.json": lambda s, b: s == 200
+        and isinstance(json.loads(b), dict),
+        "/slo.json": lambda s, b: s == 200
+        and isinstance(json.loads(b)["slos"], list),
+        "/healthz": lambda s, b: s in (200, 503)
+        and json.loads(b)["status"] in ("ok", "degraded", "unhealthy"),
+    }
+    scrape_counts = {path: 0 for path in checks}
+
+    def scrape(path):
+        try:
+            while not stop.is_set():
+                status, body = _get(server.port, path)
+                assert checks[path](status, body), (path, status, body[:200])
+                scrape_counts[path] += 1
+        except BaseException as e:  # pragma: no cover - failure capture
+            errors.append(e)
+
+    threads = [threading.Thread(target=mutate, args=(s,), daemon=True)
+               for s in range(2)]
+    threads += [threading.Thread(target=scrape, args=(p,), daemon=True)
+                for p in checks for _ in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        slo.unregister(tracker)
+        flight.remove_context_provider(provider)
+    assert not errors, errors
+    assert all(n >= 3 for n in scrape_counts.values()), scrape_counts
+
+
+def test_slo_json_lists_registered_tracker(server):
+    tracker = slo.register(SLOTracker(SLO(
+        name="exporter-unit", latency_threshold_s=0.2,
+        availability_target=0.99)))
+    try:
+        status, body = _get(server.port, "/slo.json")
+    finally:
+        slo.unregister(tracker)
+    assert status == 200
+    doc = json.loads(body)
+    (mine,) = [s for s in doc["slos"] if s.get("slo") == "exporter-unit"]
+    assert mine["latency"]["threshold_s"] == 0.2
+    assert mine["availability"]["target"] == 0.99
+
+
+def test_healthz_degrades_with_quarantined_pool(server):
+    name = flight.add_context_provider(
+        "exporter-hz-pool",
+        lambda: {"replica_count": 2, "healthy_count": 0})
+    try:
+        status, body = _get(server.port, "/healthz")
+    finally:
+        flight.remove_context_provider(name)
+    assert status == 503
+    assert json.loads(body)["status"] == "unhealthy"
+
+
+def test_debug_flight_serves_live_bundle(server):
+    flight.record_event("exporter.debug.smoke", x=1)
+    status, body = _get(server.port, "/debug/flight")
+    assert status == 200
+    doc = json.loads(body)
+    assert any(e["kind"] == "exporter.debug.smoke"
+               for e in doc["bundle"]["events"])

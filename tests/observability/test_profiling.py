@@ -1,0 +1,88 @@
+"""Host stack sampling: profile_block, collapsed output, bench hook."""
+
+import contextlib
+import threading
+import time
+
+import pytest
+
+from sparkdl_tpu.observability.profiling import (
+    StackProfile,
+    maybe_profile,
+    profile_block,
+)
+
+
+def _burn(stop):
+    while not stop.is_set():
+        sum(i * i for i in range(200))
+
+
+class TestStackProfile:
+    def test_samples_running_threads(self, tmp_path):
+        stop = threading.Event()
+        t = threading.Thread(target=_burn, args=(stop,),
+                             name="burner", daemon=True)
+        t.start()
+        try:
+            path = tmp_path / "out.folded"
+            with profile_block(path, interval_s=0.002) as prof:
+                time.sleep(0.15)
+        finally:
+            stop.set()
+            t.join()
+        assert prof.n_samples >= 5
+        lines = path.read_text().splitlines()
+        assert lines, "no stacks written"
+        for line in lines:
+            stack, count = line.rsplit(" ", 1)
+            assert int(count) >= 1
+            assert ";" in stack  # root;...;leaf
+        # the burner thread's stack is in there, rooted at its name,
+        # with file:function frames
+        burner = [ln for ln in lines if ln.startswith("burner;")]
+        assert burner, lines[:5]
+        assert any("test_profiling.py:_burn" in ln for ln in burner)
+
+    def test_sampler_excludes_itself(self, tmp_path):
+        with profile_block(None, interval_s=0.002) as prof:
+            time.sleep(0.05)
+        assert not any("sparkdl-stack-sampler" in s for s in prof.samples)
+
+    def test_manual_sampling(self):
+        prof = StackProfile()
+        prof.sample_once()
+        prof.sample_once()
+        assert prof.n_samples == 2
+        # this (running) test frame is visible in its own sample
+        assert any("test_profiling.py:test_manual_sampling" in s
+                   for s in prof.samples)
+
+class TestMaybeProfile:
+    def test_disabled_is_noop(self, monkeypatch):
+        monkeypatch.delenv("SPARKDL_TPU_PROFILE", raising=False)
+        ctx = maybe_profile("unit")
+        assert isinstance(ctx, contextlib.nullcontext)
+        with ctx as prof:
+            assert prof is None
+
+    def test_zero_is_disabled(self, monkeypatch):
+        monkeypatch.setenv("SPARKDL_TPU_PROFILE", "0")
+        assert isinstance(maybe_profile("unit"), contextlib.nullcontext)
+
+    def test_bad_hz_fails_loud(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SPARKDL_TPU_PROFILE", "1")
+        monkeypatch.setenv("SPARKDL_TPU_PROFILE_DIR", str(tmp_path))
+        monkeypatch.setenv("SPARKDL_TPU_PROFILE_HZ", "0")
+        with pytest.raises(ValueError, match="SPARKDL_TPU_PROFILE_HZ"):
+            maybe_profile("unit")
+
+    def test_enabled_writes_folded_file(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SPARKDL_TPU_PROFILE", "1")
+        monkeypatch.setenv("SPARKDL_TPU_PROFILE_DIR", str(tmp_path))
+        monkeypatch.setenv("SPARKDL_TPU_PROFILE_HZ", "500")
+        with maybe_profile("unit") as prof:
+            time.sleep(0.05)
+        assert prof is not None
+        files = list(tmp_path.glob("sparkdl-profile-unit-*.folded"))
+        assert len(files) == 1

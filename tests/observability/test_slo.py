@@ -1,0 +1,205 @@
+"""SLO accounting: count_below, rolling windows, burn rates, registry."""
+
+import pytest
+
+from sparkdl_tpu.observability import slo as slo_mod
+from sparkdl_tpu.observability.registry import MetricsRegistry
+from sparkdl_tpu.observability.slo import SLO, SLOTracker
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _reg_with_traffic():
+    reg = MetricsRegistry()
+    reg.histogram(slo_mod.LATENCY_METRIC, buckets=(0.05, 0.1, 0.5))
+    reg.counter(slo_mod.REQUESTS_METRIC, labels=("outcome",))
+    return reg
+
+
+def _serve(reg, *, fast=0, slow=0, failed=0):
+    lat = reg.get(slo_mod.LATENCY_METRIC)
+    req = reg.get(slo_mod.REQUESTS_METRIC)
+    for _ in range(fast):
+        lat.observe(0.01)
+        req.inc(outcome="completed")
+    for _ in range(slow):
+        lat.observe(0.4)
+        req.inc(outcome="completed")
+    for _ in range(failed):
+        req.inc(outcome="failed")
+
+
+class TestCountBelow:
+    def test_exact_at_bucket_edges(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(0.1, 1.0))
+        for v in (0.05, 0.05, 0.5, 2.0):
+            h.observe(v)
+        good, total = h.count_below(0.1)
+        assert (good, total) == (2.0, 4)
+        good, _ = h.count_below(1.0)
+        assert good == 3.0
+
+    def test_interpolates_inside_bucket(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(0.1, 1.0))
+        for _ in range(10):
+            h.observe(0.5)  # all in the (0.1, 1.0] bucket
+        good, total = h.count_below(0.55)
+        assert total == 10
+        assert good == pytest.approx(10 * (0.55 - 0.1) / 0.9)
+
+    def test_overflow_never_counts_good(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(0.1,))
+        h.observe(5.0)
+        good, total = h.count_below(10.0)
+        assert (good, total) == (0.0, 1)
+
+    def test_sums_across_label_series(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", labels=("k",), buckets=(1.0,))
+        h.observe(0.5, k="a")
+        h.observe(0.5, k="b")
+        assert h.count_below(1.0) == (2.0, 2)
+
+    def test_non_histogram_rejected(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("c").count_below(1.0)
+
+
+class TestSLOValidation:
+    def test_bad_targets_rejected(self):
+        with pytest.raises(ValueError):
+            SLO(name="x", availability_target=1.0)
+        with pytest.raises(ValueError):
+            SLO(name="x", latency_threshold_s=0.0)
+        with pytest.raises(ValueError):
+            SLO(name="", )
+        with pytest.raises(ValueError):
+            SLO(name="x", window_s=0)
+
+
+class TestTracker:
+    def test_compliance_and_burn(self):
+        reg = _reg_with_traffic()
+        clock = FakeClock()
+        tracker = SLOTracker(
+            SLO(name="t", latency_threshold_s=0.1, latency_target=0.9,
+                availability_target=0.99, window_s=100.0),
+            reg=reg, clock=clock)
+        _serve(reg, fast=90, slow=10, failed=0)
+        clock.t = 10.0
+        rep = tracker.sample()
+        lat = rep["latency"]
+        assert lat["requests"] == 100
+        assert lat["compliance"] == pytest.approx(0.9)
+        # error rate 10% against a 10% budget: burning exactly at pace
+        assert lat["burn_rate"] == pytest.approx(1.0)
+        assert lat["budget_remaining"] == pytest.approx(0.0)
+        avail = rep["availability"]
+        assert avail["compliance"] == 1.0
+        assert avail["burn_rate"] == 0.0
+
+    def test_admission_rejects_burn_availability(self):
+        # shed load at the DOOR is an availability failure: QueueFull
+        # rejects never reach the outcome counter, so the tracker folds
+        # sparkdl_queue_rejected_total into the denominator
+        reg = _reg_with_traffic()
+        reg.counter(slo_mod.REJECTED_METRIC)
+        tracker = SLOTracker(
+            SLO(name="t", availability_target=0.9, window_s=100.0),
+            reg=reg, clock=FakeClock())
+        _serve(reg, fast=50)
+        reg.get(slo_mod.REJECTED_METRIC).inc(50)  # half turned away
+        rep = tracker.sample()
+        avail = rep["availability"]
+        assert avail["requests"] == 100
+        assert avail["rejected"] == 50
+        assert avail["compliance"] == pytest.approx(0.5)
+        assert avail["burn_rate"] == pytest.approx(5.0)
+
+    def test_availability_burn(self):
+        reg = _reg_with_traffic()
+        tracker = SLOTracker(
+            SLO(name="t", availability_target=0.99, window_s=100.0),
+            reg=reg, clock=FakeClock())
+        _serve(reg, fast=98, failed=2)
+        rep = tracker.sample()
+        assert rep["latency"] is None  # dimension not declared
+        # 2% errors against a 1% budget: burning at 2x
+        assert rep["availability"]["burn_rate"] == pytest.approx(2.0)
+        assert rep["availability"]["budget_remaining"] == 0.0
+
+    def test_window_evicts_old_traffic(self):
+        reg = _reg_with_traffic()
+        clock = FakeClock()
+        tracker = SLOTracker(
+            SLO(name="t", latency_threshold_s=0.1, latency_target=0.9,
+                availability_target=0.99, window_s=50.0),
+            reg=reg, clock=clock)
+        _serve(reg, slow=10)          # all violations, at t=0 baseline
+        clock.t = 10.0
+        assert tracker.sample()["latency"]["compliance"] == 0.0
+        clock.t = 100.0
+        tracker.sample()              # rolls the bad epoch out of window
+        _serve(reg, fast=10)
+        clock.t = 110.0
+        rep = tracker.sample()
+        assert rep["latency"]["compliance"] == 1.0
+        assert rep["latency"]["requests"] == 10
+
+    def test_no_traffic_burns_nothing(self):
+        reg = _reg_with_traffic()
+        tracker = SLOTracker(SLO(name="t", latency_threshold_s=0.1),
+                             reg=reg, clock=FakeClock())
+        rep = tracker.sample()
+        assert rep["latency"]["compliance"] is None
+        assert rep["latency"]["burn_rate"] == 0.0
+        assert rep["availability"]["requests"] == 0
+
+    def test_registry_reset_clamps_to_empty_window(self):
+        reg = _reg_with_traffic()
+        clock = FakeClock()
+        tracker = SLOTracker(SLO(name="t", latency_threshold_s=0.1),
+                             reg=reg, clock=clock)
+        _serve(reg, fast=10)
+        clock.t = 1.0
+        tracker.sample()
+        reg.reset()  # cumulative series go backwards
+        clock.t = 2.0
+        rep = tracker.sample()
+        assert rep["availability"]["burn_rate"] == 0.0  # no false alarm
+
+    def test_gauges_published(self):
+        reg = _reg_with_traffic()
+        tracker = SLOTracker(
+            SLO(name="gauged", latency_threshold_s=0.1,
+                latency_target=0.9),
+            reg=reg, clock=FakeClock())
+        _serve(reg, fast=9, slow=1)
+        tracker.sample()
+        burn = reg.get("sparkdl_slo_burn_rate").snapshot_values()
+        assert burn['slo="gauged",dimension="latency"'] \
+            == pytest.approx(1.0)
+        obj = reg.get("sparkdl_slo_objective").snapshot_values()
+        assert obj['slo="gauged",dimension="latency"'] == 0.9
+
+    def test_register_report_unregister(self):
+        reg = _reg_with_traffic()
+        tracker = slo_mod.register(SLOTracker(
+            SLO(name="proc-listed", latency_threshold_s=0.1), reg=reg))
+        try:
+            assert any(r.get("slo") == "proc-listed"
+                       for r in slo_mod.slo_report())
+        finally:
+            slo_mod.unregister(tracker)
+        assert not any(r.get("slo") == "proc-listed"
+                       for r in slo_mod.slo_report())

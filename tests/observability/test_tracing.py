@@ -148,10 +148,12 @@ class TestDisabled:
 
 class TestServingPropagation:
     def test_request_spans_cross_microbatcher_thread(self, tmp_path):
-        """The ISSUE 2 online-path contract: a request submitted inside a
-        caller span produces queue-wait / batch-assembly / device-step
-        spans in the MicroBatcher WORKER thread, all linked to the
-        submitter's trace via the Request-carried context."""
+        """The online-path contract (ISSUE 2, re-rooted per-request by
+        ISSUE 9): a submitted request owns a trace id (= its
+        ``fut.request_id``); queue-wait and the terminal request span
+        carry that trace directly, and the MicroBatcher WORKER thread's
+        batch-assembly / device-step spans fan in via their ``links``
+        attribute — ``spans_for_trace`` reassembles the whole request."""
         from sparkdl_tpu.serving import ServingEngine
         from sparkdl_tpu.transformers._inference import BatchedRunner
 
@@ -162,28 +164,34 @@ class TestServingPropagation:
                 lambda b: b["x"] * 2.0, batch_size=8, data_parallel=False
             )
             with ServingEngine(runner, max_wait_s=0.001) as eng:
-                with span("client_call") as client:
-                    fut = eng.submit({"x": np.ones((3,), np.float32)})
-                    np.testing.assert_array_equal(
-                        fut.result(timeout=30), np.full((3,), 2.0)
-                    )
-            trace_id = client.context.trace_id
+                fut = eng.submit({"x": np.ones((3,), np.float32)})
+                np.testing.assert_array_equal(
+                    fut.result(timeout=30), np.full((3,), 2.0)
+                )
+                rid = fut.request_id
+                spans = eng.trace(rid)
+            names = {e["name"] for e in spans}
+            assert {"serving.queue_wait", "serving.request",
+                    "serving.batch_assemble",
+                    "serving.device_step"} <= names, names
+            # request-owned spans carry the request's trace id directly
+            for name in ("serving.queue_wait", "serving.request"):
+                ev = [e for e in spans if e["name"] == name][0]
+                assert ev["args"]["trace_id"] == rid
+                assert ev["args"]["request_id"] == rid
+            # batch spans fan in via links, not trace ownership
+            assemble = [e for e in spans
+                        if e["name"] == "serving.batch_assemble"][0]
+            assert rid in assemble["args"]["links"]
             main_tid = threading.get_ident() & 0x7FFFFFFF
-            for name in ("serving.queue_wait", "serving.batch_assemble",
-                         "serving.device_step"):
-                evs = [e for e in _by_name(name)
-                       if e["args"]["trace_id"] == trace_id]
-                assert evs, f"{name} not linked to the client trace"
-            # assemble/step genuinely ran on the worker thread
-            assert _by_name("serving.batch_assemble")[0]["tid"] != main_tid
-            # and the whole request exports as a Perfetto-loadable trace
-            # (the ISSUE 2 acceptance artifact)
+            assert assemble["tid"] != main_tid  # ran on the worker thread
+            # and the request exports alone as a Perfetto-loadable trace
             path = tmp_path / "serving_trace.json"
-            export_chrome_trace(path)
+            export_chrome_trace(path, trace_id=rid)
             doc = json.loads(path.read_text())
             names = {e["name"] for e in doc["traceEvents"]}
             assert {"serving.queue_wait", "serving.batch_assemble",
-                    "serving.device_step"} <= names
+                    "serving.device_step", "serving.request"} <= names
         finally:
             tracing.disable_tracing()
             tracing.clear_trace()

@@ -1,0 +1,172 @@
+"""Per-request trace IDs end to end (ISSUE 9): submit -> trace replay."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.observability import tracing
+from sparkdl_tpu.serving import ReplicaPool, ServingEngine
+from sparkdl_tpu.transformers._inference import BatchedRunner
+
+W = jnp.asarray(
+    np.random.default_rng(0).standard_normal((8, 8)), jnp.float32)
+
+
+def _runner(batch_size=4):
+    return BatchedRunner(lambda b: jnp.tanh(b["x"] @ W),
+                         batch_size=batch_size, data_parallel=False)
+
+
+@pytest.fixture
+def traced():
+    tracing.clear_trace()
+    tracing.enable_tracing()
+    try:
+        yield
+    finally:
+        tracing.disable_tracing()
+        tracing.clear_trace()
+
+
+class TestRequestIds:
+    def test_assigned_even_with_tracing_off(self):
+        tracing.disable_tracing()
+        with ServingEngine(_runner(), max_wait_s=0.001) as eng:
+            a = eng.submit({"x": np.zeros((8,), np.float32)})
+            b = eng.submit({"x": np.zeros((8,), np.float32)})
+            a.result(timeout=30), b.result(timeout=30)
+            assert isinstance(a.request_id, int) and a.request_id > 0
+            assert a.request_id != b.request_id
+            # no spans with tracing off: trace() is empty, never raises
+            assert eng.trace(a.request_id) == []
+
+    def test_request_context_free_when_disabled(self):
+        tracing.disable_tracing()
+        assert tracing.request_context(123) is None
+        assert tracing.new_trace_context() is None
+
+
+class TestEndToEndTrace:
+    def test_full_request_trace(self, traced):
+        with ServingEngine(_runner(), max_wait_s=0.002) as eng:
+            futs = [eng.submit({"x": np.full((8,), float(i), np.float32)})
+                    for i in range(8)]
+            for f in futs:
+                f.result(timeout=30)
+            for f in futs:
+                spans = eng.trace(f.request_id)
+                names = {s["name"] for s in spans}
+                assert {"serving.queue_wait", "serving.request",
+                        "serving.batch_assemble"} <= names, names
+                req = [s for s in spans if s["name"] == "serving.request"]
+                assert len(req) == 1
+                assert req[0]["args"]["ok"] is True
+                assert req[0]["args"]["request_id"] == f.request_id
+                assert req[0]["args"]["trace_id"] == f.request_id
+
+    def test_batch_spans_link_all_riders(self, traced):
+        # force coalescing: batch of 4 with a generous window
+        with ServingEngine(_runner(batch_size=4), max_wait_s=0.25) as eng:
+            futs = [eng.submit({"x": np.zeros((8,), np.float32)})
+                    for _ in range(4)]
+            for f in futs:
+                f.result(timeout=30)
+        rids = {f.request_id for f in futs}
+        assembles = [e for e in tracing.trace_events()
+                     if e["name"] == "serving.batch_assemble"]
+        linked = set()
+        for ev in assembles:
+            linked.update(ev["args"]["links"])
+        assert rids <= linked, (rids, linked)
+        # every rider's trace reaches a device-step span via the links
+        for rid in rids:
+            names = {s["name"] for s in tracing.spans_for_trace(rid)}
+            assert "serving.device_step" in names, (rid, names)
+
+    def test_traces_are_disjoint_across_batches(self, traced):
+        with ServingEngine(_runner(batch_size=1), max_wait_s=0.0) as eng:
+            a = eng.submit({"x": np.zeros((8,), np.float32)})
+            a.result(timeout=30)
+            b = eng.submit({"x": np.ones((8,), np.float32)})
+            b.result(timeout=30)
+        a_spans = {s["args"]["span_id"]
+                   for s in tracing.spans_for_trace(a.request_id)}
+        b_spans = {s["args"]["span_id"]
+                   for s in tracing.spans_for_trace(b.request_id)}
+        assert not a_spans & b_spans  # batch-of-1: nothing shared
+
+    def test_submitter_span_joins_the_request_trace(self, traced):
+        # a caller wrapping submit() in its own span must still reach
+        # the request's spans from ITS trace id: the queue-wait span
+        # links the submitter's trace, and follow pulls the rest
+        with ServingEngine(_runner(), max_wait_s=0.001) as eng:
+            with tracing.span("client_call") as client:
+                fut = eng.submit({"x": np.zeros((8,), np.float32)})
+            fut.result(timeout=30)
+        names = {s["name"]
+                 for s in tracing.spans_for_trace(client.context.trace_id)}
+        assert {"client_call", "serving.queue_wait",
+                "serving.request"} <= names, names
+
+    def test_failed_request_span_carries_error(self, traced):
+        def extract(payload):
+            if payload.get("poison"):
+                raise ValueError("bad payload")
+            return {"x": payload["x"]}
+
+        with ServingEngine(_runner(), max_wait_s=0.001,
+                           extract=extract) as eng:
+            bad = eng.submit({"poison": True})
+            with pytest.raises(ValueError):
+                bad.result(timeout=30)
+        req = [s for s in tracing.spans_for_trace(bad.request_id)
+               if s["name"] == "serving.request"]
+        assert req and req[0]["args"]["ok"] is False
+        assert req[0]["args"]["error"] == "ValueError"
+
+    def test_perfetto_export_of_one_request(self, traced, tmp_path):
+        with ServingEngine(_runner(), max_wait_s=0.001) as eng:
+            fut = eng.submit({"x": np.zeros((8,), np.float32)})
+            fut.result(timeout=30)
+            other = eng.submit({"x": np.ones((8,), np.float32)})
+            other.result(timeout=30)
+        path = tmp_path / "one_request.json"
+        n = tracing.export_chrome_trace(path, trace_id=fut.request_id)
+        assert n >= 2
+        doc = json.loads(path.read_text())
+        ids = {e["args"]["trace_id"] for e in doc["traceEvents"]}
+        # only this request's trace + its linked batch traces
+        assert other.request_id not in ids
+
+
+class TestReplicaPoolTrace:
+    def test_replica_span_lands_in_rider_trace(self, traced):
+        pool = ReplicaPool(
+            lambda b: jnp.tanh(b["x"] @ W), batch_size=4,
+            devices=jax.local_devices()[:2],
+        )
+        try:
+            pool.warmup({"x": np.zeros((4, 8), np.float32)})
+            with ServingEngine(pool, max_wait_s=0.002) as eng:
+                futs = [eng.submit(
+                    {"x": np.full((8,), float(i), np.float32)})
+                    for i in range(8)]
+                for f in futs:
+                    f.result(timeout=30)
+                names = {s["name"] for s in eng.trace(futs[0].request_id)}
+            assert "serving.replica_batch" in names, names
+        finally:
+            pool.close()
+
+
+class TestInflightIds:
+    def test_engine_reports_queued_ids(self, traced):
+        # a batcher that never starts: everything stays queued
+        from sparkdl_tpu.serving.queue import RequestQueue
+
+        q = RequestQueue(max_depth=8)
+        futs = [q.submit({"x": i}) for i in range(3)]
+        assert q.pending_request_ids() == [f.request_id for f in futs]

@@ -1,0 +1,48 @@
+"""The duplicate-test-basename guard (ISSUE 9 satellite): tests/ has no
+__init__.py, so two test files with the same basename in different
+subdirs collide at collection (bit PR 8). conftest fails the whole run
+loudly at import; these tests pin the detector itself."""
+
+import pytest
+
+from conftest import fail_on_duplicate_test_basenames
+
+
+def _mk(root, rel):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("")
+    return path
+
+
+def test_clean_tree_passes(tmp_path):
+    _mk(tmp_path, "serving/test_queue.py")
+    _mk(tmp_path, "ingest/test_pipeline.py")
+    fail_on_duplicate_test_basenames(tmp_path)  # no raise
+
+
+def test_duplicate_basenames_fail_loudly(tmp_path):
+    _mk(tmp_path, "serving/test_pipeline.py")
+    _mk(tmp_path, "ingest/test_pipeline.py")
+    with pytest.raises(pytest.UsageError) as exc:
+        fail_on_duplicate_test_basenames(tmp_path)
+    msg = str(exc.value)
+    assert "test_pipeline.py" in msg
+    assert "serving" in msg and "ingest" in msg
+
+
+def test_non_test_files_ignored(tmp_path):
+    _mk(tmp_path, "serving/helpers.py")
+    _mk(tmp_path, "ingest/helpers.py")
+    fail_on_duplicate_test_basenames(tmp_path)  # helpers may repeat
+
+
+def test_live_tree_is_clean():
+    """The actual tests/ tree must satisfy its own guard (conftest
+    already enforced this at import — this documents it as a test)."""
+    import os
+
+    import conftest
+
+    fail_on_duplicate_test_basenames(
+        os.path.dirname(os.path.abspath(conftest.__file__)))
