@@ -33,6 +33,17 @@ The JSON line also carries `fetch_wait_share` (host seconds blocked
 collecting async D2H results / measured wall — the number the async
 completion layer exists to shrink) and `replica_count` next to
 `dispatch_count`/`overhead_share`.
+
+Continuous-GPT section (ISSUE 10): a shared-prefix chat workload is
+replayed through the paged (block pool + prefix cache + chunked
+prefill) AND dense continuous engines over the same weights.
+`BENCH_PREFIX_SHARE` (default 0.75) sets the fraction of each prompt
+that is a common prefix, `BENCH_PROMPT_LEN` (96) the prompt length,
+`BENCH_GPT_REQUESTS` (32; 0 disables the section). The JSON line gains
+`prefix_hit_rate` / `kv_blocks_used` / `prefill_chunks` and a
+`kv_paged` comparison block (per-layout wall + prefill-time share +
+bitwise verdict) — the prefill share dropping with the hit rate is the
+paged layout's headline win.
 """
 
 import json
@@ -66,6 +77,130 @@ def _replay(engine, arrivals):
     return (snap["completed"], duration,
             1e3 * pcts["p50"], 1e3 * pcts["p95"],
             snap["batch_occupancy_pct"])
+
+
+def _gpt_paged_section():
+    """Shared-prefix chat workload through the continuous GPT engine,
+    dense vs paged over the same weights: returns the `kv_paged` block
+    plus the headline prefix/pool fields (None when disabled)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+    from sparkdl_tpu.serving import ContinuousGPTEngine
+
+    n_req = int(os.environ.get("BENCH_GPT_REQUESTS", "32"))
+    if n_req < 1:
+        return None
+    share = float(os.environ.get("BENCH_PREFIX_SHARE", "0.75"))
+    if not 0.0 <= share <= 1.0:
+        raise ValueError(f"BENCH_PREFIX_SHARE must be in [0,1]: {share}")
+    plen = int(os.environ.get("BENCH_PROMPT_LEN", "96"))
+    max_new = 16
+    # the dense engine prefills at the prompt-length BUCKET (the shared
+    # pow2 policy), so max_len must cover bucket + budget for both
+    # layouts
+    from sparkdl_tpu.runtime.batching import pow2_bucket
+
+    max_len = pow2_bucket(plen) + max_new
+    cfg = GPTConfig(
+        vocab_size=256, hidden_size=128, num_layers=3, num_heads=4,
+        intermediate_size=256, max_seq_len=4 * max_len,
+    )
+    model = GPTLMHeadModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+    rng = np.random.default_rng(5)
+    n_shared = int(round(share * plen))
+    prefix = rng.integers(1, cfg.vocab_size, n_shared).tolist()
+    prompts = [
+        prefix + rng.integers(1, cfg.vocab_size, plen - n_shared).tolist()
+        for _ in range(n_req)
+    ]
+    warm = rng.integers(1, cfg.vocab_size, plen).tolist()
+    # same shape as a measured request (shared prefix + fresh suffix)
+    # but NOT in the measured set: warms the suffix-width chunk program
+    warm_suffix = (prefix
+                   + rng.integers(1, cfg.vocab_size,
+                                  plen - n_shared).tolist())
+
+    def run(layout):
+        eng = ContinuousGPTEngine(
+            cfg, variables, n_slots=8, max_len=max_len,
+            kv_layout=layout, kv_block_size=8,
+            # engine-default prefill budget (256: above these prompts,
+            # so a cold admission is one bucketed chunk and a
+            # prefix-hit suffix is one fused dispatch); pin via
+            # SPARKDL_TPU_PREFILL_CHUNK to study throttled admission
+            prefill_chunk=None,
+            idle_wait_s=0.0005,
+        )
+        # compile warmup, then seed requests from the workload:
+        # steady-state shared-prompt serving is what is being measured,
+        # and in steady state the shared prefix IS cached — the cold
+        # first requests are warmup, like the compile. The seeds cover
+        # every bucketed chunk program the replay will hit (cold-width,
+        # suffix-width, full-hit-width). Dense ignores the seeds; it
+        # has no cache to warm.
+        eng.submit(warm, 2).result(timeout=120)
+        eng.submit(prompts[0], max_new).result(timeout=120)
+        eng.submit(warm_suffix, max_new).result(timeout=120)
+        eng.submit(prompts[0], max_new).result(timeout=120)
+        snap0 = eng.snapshot()
+        kv0 = snap0["kv"] or {}
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new) for p in prompts]
+        outs = [np.asarray(f.result(timeout=120)) for f in futs]
+        wall = time.perf_counter() - t0
+        snap = eng.snapshot()
+        kv = snap["kv"] or {}
+        eng.close()
+        prefill_s = snap["prefill_seconds"] - snap0["prefill_seconds"]
+        hits = (kv.get("prefix_hits", 0) or 0) - (
+            kv0.get("prefix_hits", 0) or 0)
+        misses = (kv.get("prefix_misses", 0) or 0) - (
+            kv0.get("prefix_misses", 0) or 0)
+        return {
+            "outs": outs,
+            "stats": {
+                "wall_s": round(wall, 4),
+                "req_s": round(len(prompts) / wall, 2),
+                "prefill_seconds": round(prefill_s, 4),
+                "prefill_share": round(prefill_s / wall, 4),
+                "prefix_hit_rate": (
+                    round(hits / (hits + misses), 4)
+                    if hits + misses else None),
+                "kv_blocks_used_peak": kv.get("blocks_used_peak"),
+                "prefill_chunks": kv.get("prefill_chunks"),
+            },
+        }
+
+    dense = run("dense")
+    paged = run("paged")
+    bitwise = all(
+        np.array_equal(a, b)
+        for a, b in zip(dense["outs"], paged["outs"])
+    )
+    d_share, p_share = (dense["stats"]["prefill_share"],
+                        paged["stats"]["prefill_share"])
+    d_pf, p_pf = (dense["stats"]["prefill_seconds"],
+                  paged["stats"]["prefill_seconds"])
+    return {
+        "prefix_share": share,
+        "prompt_len": plen,
+        "requests": n_req,
+        "dense": dense["stats"],
+        "paged": paged["stats"],
+        "paged_bitwise_vs_dense": bitwise,
+        # seconds spent prefilling, dense/paged (the compute the prefix
+        # cache eliminates) and the share-of-wall ratio (diluted when
+        # paged also wins the denominator: a faster total wall)
+        "prefill_seconds_ratio": (
+            round(d_pf / p_pf, 4) if p_pf else None),
+        "prefill_share_ratio": (
+            round(d_share / p_share, 4) if p_share else None),
+    }
 
 
 def main() -> None:
@@ -189,6 +324,11 @@ def main() -> None:
         overhead_share,
     )
 
+    # Paged KV serving (ISSUE 10): shared-prefix chat workload, dense
+    # vs paged continuous GPT — runs BEFORE the registry snapshot below
+    # so the kv/prefix series ride the artifact.
+    kv_paged = _gpt_paged_section()
+
     gap = calibrate_dispatch_gap()
     n_dispatches = dispatch_count("serving")
     snap_wall = registry().snapshot().get(
@@ -214,6 +354,16 @@ def main() -> None:
         "fetch_wait_share": round(min(1.0, fetch_wait / dur_mb), 4),
         "replica_count": replica_snap.get("replica_count", 1),
         "replicas": replica_snap.get("replicas"),
+        # Paged KV cache (ISSUE 10): prefix reuse + block pool + chunked
+        # prefill on the shared-prefix GPT workload (None when
+        # BENCH_GPT_REQUESTS=0)
+        "prefix_hit_rate": (kv_paged or {}).get(
+            "paged", {}).get("prefix_hit_rate"),
+        "kv_blocks_used": (kv_paged or {}).get(
+            "paged", {}).get("kv_blocks_used_peak"),
+        "prefill_chunks": (kv_paged or {}).get(
+            "paged", {}).get("prefill_chunks"),
+        "kv_paged": kv_paged,
         # SLO accounting + flight recorder (ISSUE 9): declared objective
         # with rolling burn, and the event-ring volume this run produced
         "slo": replica_snap.get("slo"),

@@ -121,6 +121,31 @@ def init_cache(config: GPTConfig, batch: int, max_len: int,
     }
 
 
+def init_block_pool(config: GPTConfig, n_blocks: int,
+                    block_size: int) -> dict:
+    """Zeroed block-paged KV pool for continuous serving
+    (``serving.kv_blocks``): k/v stacked over layers,
+    ``[num_layers, n_blocks, block_size, H, D]``.
+
+    Unlike :func:`init_cache` (one dense row per batch slot, capacity
+    ``batch x max_len`` whether or not tokens exist), the pool's
+    capacity is ``n_blocks x block_size`` TOKENS shared by every slot: a
+    slot maps its logical columns onto pool blocks through a block
+    table, the serving engine gathers a virtual dense cache per decode
+    step, and the same physical block can back the shared prompt prefix
+    of many slots (``serving.prefix_cache``). Bookkeeping (free list,
+    refcounts, tables) is host-side and lives in
+    :class:`~sparkdl_tpu.serving.kv_blocks.KVBlockPool`.
+    """
+    hd = config.hidden_size // config.num_heads
+    shape = (config.num_layers, n_blocks, block_size,
+             config.num_heads, hd)
+    return {
+        "k": jnp.zeros(shape, config.dtype),
+        "v": jnp.zeros(shape, config.dtype),
+    }
+
+
 class GPTAttention(nn.Module):
     config: GPTConfig
     layer_idx: int

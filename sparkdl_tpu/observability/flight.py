@@ -462,10 +462,12 @@ def healthz_report() -> dict:
       no intact fallback (verdict ``corrupt``, not pinned): this host
       cannot currently serve / resume. ``/healthz`` answers 503.
     * **degraded** — a pool is serving with quarantined replicas, the
-      process retry budget ran dry, or the last restore fell back past
-      a torn checkpoint / failed ambiguously (``fallback`` /
-      ``unreadable`` / pinned-step ``corrupt``): route around if
-      possible, still serving.
+      process retry budget ran dry, a serving engine's KV block pool is
+      on an exhaustion streak (admissions deferring — self-recovering
+      as slots retire, hence never ``unhealthy``), or the last restore
+      fell back past a torn checkpoint / failed ambiguously
+      (``fallback`` / ``unreadable`` / pinned-step ``corrupt``): route
+      around if possible, still serving.
     * **ok** — everything else (including "no pools registered").
 
     A provider that RAISES lands under ``provider_errors`` (never in
@@ -474,6 +476,7 @@ def healthz_report() -> dict:
     healthy.
     """
     pools = []
+    kv_pools = []
     errors = []
     status = "ok"
     for name, fn in _providers_snapshot():
@@ -482,6 +485,22 @@ def healthz_report() -> dict:
         except Exception as e:
             errors.append({"provider": name, "error": repr(e)})
             continue
+        if isinstance(out, dict) and isinstance(out.get("kv_pool"), dict):
+            kvp = out["kv_pool"]
+            kv_pools.append({
+                "provider": name,
+                "blocks_total": kvp.get("blocks_total"),
+                "blocks_used": kvp.get("blocks_used"),
+                "blocks_cached": kvp.get("blocks_cached"),
+                "deferrals_total": kvp.get("deferrals_total"),
+                "exhausted_streak": kvp.get("exhausted_streak"),
+            })
+            if int(kvp.get("exhausted_streak") or 0) > 0 \
+                    and status == "ok":
+                # admissions are deferring on an exhausted block pool:
+                # degraded, never unhealthy — it self-recovers as slots
+                # retire and free their blocks
+                status = "degraded"
         if not (isinstance(out, dict) and "healthy_count" in out):
             continue  # engine-level providers: not a pool view
         healthy = int(out.get("healthy_count") or 0)
@@ -515,6 +534,7 @@ def healthz_report() -> dict:
     return {
         "status": status,
         "replica_pools": pools,
+        "kv_pools": kv_pools,
         "provider_errors": errors,
         "retry_budget": {
             "remaining": budget.remaining,

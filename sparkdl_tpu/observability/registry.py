@@ -96,6 +96,36 @@ class _Bound:
         self._family._observe(self._key, value)
 
 
+class GaugeShare:
+    """One contributor's share of a SUMMED process gauge.
+
+    Several live objects (request queues, KV block pools) can feed the
+    same gauge; each pushes *deltas* of its own value so neighbors are
+    never clobbered. ``registry().reset()`` (test isolation) zeroes the
+    gauge under every contributor — the generation stamp restarts this
+    contributor's baseline at 0 instead of pushing a stale negative
+    delta. Call :meth:`set` with the contributor's CURRENT value; call
+    ``set(0)`` on close to retract the contribution.
+
+    Not self-locking: callers serialize their own ``set`` (the queue's
+    condition lock, the serving-engine lock).
+    """
+
+    def __init__(self, family: "MetricFamily"):
+        self._family = family
+        self._reported = 0.0
+        self._gen = registry().generation
+
+    def set(self, value: float) -> None:
+        gen = registry().generation
+        if gen != self._gen:
+            self._reported = 0.0
+            self._gen = gen
+        if value != self._reported:
+            self._family.inc(value - self._reported)
+            self._reported = value
+
+
 class MetricFamily:
     """One named metric (counter/gauge/histogram) with 0+ label dims."""
 

@@ -18,9 +18,14 @@ Three layers, separately testable:
   :class:`~sparkdl_tpu.transformers._inference.BatchedRunner` (dp-sharded
   on multi-chip hosts), per-request error isolation, graceful drain;
 - :mod:`~sparkdl_tpu.serving.continuous` — continuous batching for GPT
-  generation over a per-slot KV cache: finished rows free their slot
-  mid-stream, new prompts join the in-flight decode batch, greedy tokens
-  stay identical to the unbatched decode;
+  generation: finished rows free their slot mid-stream, new prompts
+  join the in-flight decode batch, greedy tokens stay identical to the
+  unbatched decode. Default KV layout is block-paged
+  (:mod:`~sparkdl_tpu.serving.kv_blocks` pool +
+  :mod:`~sparkdl_tpu.serving.prefix_cache` radix prefix reuse +
+  chunked prefill): memory bounded by live tokens, shared prompt
+  prefixes served from cache, exhausted-pool admissions deferred in
+  order;
 - :mod:`~sparkdl_tpu.serving.replicas` — multi-device replica serving:
   one pinned jit-cached executor per local chip, micro-batches routed
   whole by least outstanding work, quarantine-on-repeated-failure, with
@@ -34,8 +39,10 @@ shared :func:`~sparkdl_tpu.observability.metrics.percentile` helpers.
 
 from sparkdl_tpu.serving.continuous import ContinuousGPTEngine, GenRequest
 from sparkdl_tpu.serving.engine import ServingEngine
+from sparkdl_tpu.serving.kv_blocks import KVBlockPool
 from sparkdl_tpu.serving.metrics import ServingMetrics
 from sparkdl_tpu.serving.microbatcher import MicroBatcher
+from sparkdl_tpu.serving.prefix_cache import PrefixCache
 from sparkdl_tpu.serving.queue import (
     DeadlineExceededError,
     EngineClosedError,
@@ -57,7 +64,9 @@ __all__ = [
     "EngineClosedError",
     "GenRequest",
     "HungDispatchError",
+    "KVBlockPool",
     "MicroBatcher",
+    "PrefixCache",
     "QueueFullError",
     "ReplicaPool",
     "Request",

@@ -19,6 +19,26 @@ batching is a scheduling decision, never a quality decision.
 
 Decode is greedy (temperature 0), the deterministic serving default;
 sampled decode stays on the lockstep ``DeepTextGenerator`` path.
+
+KV layouts (``kv_layout=``, ROADMAP item 4):
+
+- ``"paged"`` (default) — block-paged KV pool
+  (:mod:`~sparkdl_tpu.serving.kv_blocks`): each slot maps its columns
+  onto refcounted ``block_size``-token blocks through a block table,
+  the jitted decode step gathers a virtual dense cache from the table
+  and scatters the written column back, so persistent KV memory is
+  bounded by allocated tokens, not ``n_slots x max_len``. Admission
+  against an exhausted pool DEFERS (re-queues in order) instead of
+  erroring. Prompts are prefilled right-aligned in bounded CHUNKS
+  (``prefill_chunk`` tokens per engine tick, interleaved with decode
+  ticks — a long prompt no longer freezes in-flight decode latency),
+  and a radix prefix cache
+  (:mod:`~sparkdl_tpu.serving.prefix_cache`) lets a request reuse the
+  cached K/V of its longest shared prompt prefix and prefill only the
+  suffix (partial tail blocks shared copy-on-write). Greedy tokens
+  stay oracle-identical on every path (tests/serving/test_kv_paged.py).
+- ``"dense"`` — the original one-dense-buffer-per-slot layout, kept as
+  the parity oracle and fallback.
 """
 
 from __future__ import annotations
@@ -32,8 +52,10 @@ from typing import Any, Optional
 
 import numpy as np
 
+from sparkdl_tpu.observability import flight as flight_mod
 from sparkdl_tpu.observability import slo as slo_mod
 from sparkdl_tpu.observability import tracing
+from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.observability.tracing import span
 from sparkdl_tpu.runtime.completion import start_fetch
 from sparkdl_tpu.runtime.dispatch import ChainPolicy, record_dispatch
@@ -47,6 +69,16 @@ from sparkdl_tpu.serving.queue import (
 )
 
 
+_M_PREFILL_CHUNKS = registry().counter(
+    "sparkdl_prefill_chunks_total",
+    "bounded prefill chunks dispatched by continuous GPT engines")
+
+#: Consecutive pool-exhaustion deferrals before the flight recorder
+#: writes a postmortem (one defer is normal backpressure; a streak is
+#: the incident an operator will ask about).
+_EXHAUST_DUMP_STREAK = 3
+
+
 @dataclasses.dataclass
 class GenRequest:
     """One generation request: prompt token ids + token budget."""
@@ -58,11 +90,45 @@ class GenRequest:
 @dataclasses.dataclass
 class _InFlight:
     """Host-side state of one occupied slot (the left-pad count lives in
-    the engine's ``_start`` array the decode step consumes)."""
+    the engine's ``_start`` array the decode step consumes; ``blocks``
+    are the paged layout's refcounted KV blocks, released on retire)."""
 
     req: Request
     produced: list[int]
     max_new: int
+    blocks: "list[int] | None" = None
+
+
+@dataclasses.dataclass
+class _Prefill:
+    """One slot mid-chunked-prefill (paged layout): the prompt's K/V are
+    accumulating in a private batch-1 dense cache (``ck``/``cv``),
+    ``prefill_chunk`` tokens per engine tick, until installation into
+    the slot's pool blocks. ``pos`` counts prompt tokens already in the
+    cache, including the ``hit`` tokens gathered from the prefix cache
+    (whose prefill was skipped)."""
+
+    req: Request
+    prompt: np.ndarray
+    max_new: int
+    pos: int
+    hit: int
+    shared: "list[int]"
+    owned: "list[int]"
+    gather_ids: np.ndarray  # block ids backing the cached prefix
+    install_ids: np.ndarray  # owned-block targets for the final chunk
+    #: COW source (a shared partial tail block): holds an extra pool
+    #: reference until the first chunk's gather has been dispatched
+    cow_block: "int | None" = None
+    ck: Any = None  # None until the first (gather-fused) chunk ran
+    cv: Any = None
+    chunks: int = 0
+
+    def all_blocks(self) -> "list[int]":
+        """Every pool reference this prefill holds (release on abort)."""
+        return (self.shared + self.owned
+                + ([self.cow_block] if self.cow_block is not None
+                   else []))
 
 
 class ContinuousGPTEngine:
@@ -70,9 +136,19 @@ class ContinuousGPTEngine:
 
     ``submit(prompt_ids, max_new_tokens)`` returns a Future of the
     generated ids (prompt not included). Admission control is two-layer:
-    queue depth (QueueFullError) and cache capacity — a request whose
-    bucketed prompt + budget cannot fit ``max_len`` columns is rejected
-    at submit, loudly, because its cache writes would silently drop.
+    queue depth (QueueFullError) and cache capacity. Under
+    ``kv_layout="dense"`` a request whose BUCKETED prompt + budget
+    cannot fit ``max_len`` columns is rejected at submit, loudly,
+    because its cache writes would silently drop. Under the default
+    ``"paged"`` layout only what can NEVER fit rejects (raw prompt +
+    budget vs ``max_len``, worst-case blocks vs the whole pool); a
+    request that merely cannot fit right now is admitted and DEFERRED
+    at tick time — re-queued at the head, retried as slots retire and
+    free their blocks. ``kv_block_size``/``kv_blocks`` size the paged
+    pool (default: the dense worst case, so the default engine never
+    defers where dense admitted); ``prefill_chunk`` bounds the prompt
+    tokens prefilled per tick (pin via arg or
+    ``SPARKDL_TPU_PREFILL_CHUNK``).
 
     ``auto_start=False`` exposes :meth:`tick` for deterministic
     single-step tests; the default runs the loop on a daemon thread.
@@ -96,6 +172,10 @@ class ContinuousGPTEngine:
                  eos_id: Optional[int] = None,
                  idle_wait_s: float = 0.005,
                  chain_tokens: "int | None" = 1,
+                 kv_layout: str = "paged",
+                 kv_block_size: int = 16,
+                 kv_blocks: "int | None" = None,
+                 prefill_chunk: "int | None" = None,
                  metrics: ServingMetrics | None = None,
                  slo: "slo_mod.SLO | None" = None,
                  auto_start: bool = True):
@@ -105,6 +185,7 @@ class ContinuousGPTEngine:
 
         from sparkdl_tpu.models.gpt import (
             GPTLMHeadModel,
+            init_block_pool,
             init_cache,
         )
         from sparkdl_tpu.runtime.batching import default_buckets
@@ -114,6 +195,10 @@ class ContinuousGPTEngine:
         if chain_tokens is not None and chain_tokens < 1:
             raise ValueError(
                 f"chain_tokens must be >= 1, got {chain_tokens}"
+            )
+        if kv_layout not in ("paged", "dense"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'dense', got {kv_layout!r}"
             )
         if (config.positions == "learned"
                 and max_len > config.max_seq_len):
@@ -128,6 +213,7 @@ class ContinuousGPTEngine:
         self.eos_id = eos_id
         self.idle_wait_s = idle_wait_s
         self.chain_tokens = chain_tokens
+        self.kv_layout = kv_layout
         self._chain_policy = ChainPolicy(
             max_chain=chain_tokens if chain_tokens is not None else 32
         )
@@ -140,14 +226,224 @@ class ContinuousGPTEngine:
         self._model = GPTLMHeadModel(config)
         self._len_buckets = default_buckets(max_len, min_bucket=8)
         self._inflight: dict[int, _InFlight] = {}
-        self._cache = init_cache(config, n_slots, max_len, per_slot=True)
-        self._start = np.zeros((n_slots,), np.int32)
+        self._prefilling: dict[int, _Prefill] = {}
         self._last_tok = np.zeros((n_slots,), np.int32)
+        self._prefill_seconds = 0.0
+        self._prefill_chunks = 0
+        self._deferrals = 0
+        self._defer_streak = 0
+        self._max_tick_prefill_tokens = 0
+        self._prefill_rr = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
         model = self._model
+
+        if kv_layout == "paged":
+            from sparkdl_tpu.ingest.pipeline import resolve_pin
+            from sparkdl_tpu.serving.kv_blocks import KVBlockPool
+            from sparkdl_tpu.serving.prefix_cache import PrefixCache
+
+            if kv_block_size < 1:
+                raise ValueError(
+                    f"kv_block_size must be >= 1, got {kv_block_size}")
+            # default 256: the chunk is a decode-LATENCY bound (one
+            # tick never prefills more than this many tokens), so it
+            # should sit well ABOVE typical prompts — throttling every
+            # cold admission to tiny chunks serializes admission for no
+            # latency benefit. Shrink it when long prompts must not
+            # stall live decode ticks.
+            chunk, _, _ = resolve_pin(
+                prefill_chunk, "SPARKDL_TPU_PREFILL_CHUNK", 256,
+                what="prefill_chunk",
+            )
+            if chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {chunk}")
+            self.prefill_chunk = chunk
+            bs_kv = kv_block_size
+            mb = -(-max_len // bs_kv)  # table width, blocks per sequence
+            w = mb * bs_kv  # gathered virtual-cache width (>= max_len)
+            # widest chunk PROGRAM ever built: chunks bucket to their
+            # real token count, and no chunk carries more than a whole
+            # prompt (<= w) even when the per-tick budget is larger
+            self._chunk_cap = min(chunk, w)
+            # private prefill cache is one max-width chunk wider than
+            # the table span: a chunk write must never clamp
+            wp = w + self._chunk_cap
+            if kv_blocks is None:
+                # default pool = the dense layout's worst case, so the
+                # default engine can never defer where dense admitted;
+                # shrink kv_blocks to make memory the real bound
+                kv_blocks = n_slots * mb
+            if kv_blocks < 1:
+                raise ValueError(
+                    f"kv_blocks must be >= 1, got {kv_blocks}")
+            self._kv_bs = bs_kv
+            self._mb = mb
+            self._w = w
+            self._wp = wp
+            self._pool = KVBlockPool(kv_blocks, bs_kv)
+            self._prefix = PrefixCache(self._pool)
+            self._pool_kv = init_block_pool(config, kv_blocks, bs_kv)
+            # block tables: one row per slot, sentinel (= kv_blocks)
+            # marks empty entries — gather clips it, scatter drops it
+            self._table = np.full((n_slots, mb), self._pool.sentinel,
+                                  np.int32)
+            self._pidx = np.zeros((n_slots,), np.int32)
+            n_layers = config.num_layers
+            nh = config.num_heads
+            hd = config.hidden_size // config.num_heads
+            max_pos = (config.max_seq_len - 1
+                       if config.positions == "learned" else wp + chunk)
+
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               static_argnums=(5, 6))
+            def _paged_step(variables, pool, table, idx, tok, k, nb):
+                # k tokens for every slot over the BLOCK TABLE: each
+                # step gathers the table's blocks into a virtual dense
+                # [S, nb*bs] cache (same math as the dense layout, so
+                # greedy tokens stay bitwise-identical), runs the
+                # per-slot decode, then scatters the one written column
+                # back to its pool block. ``nb`` (static, bucketed) is
+                # the block count covering the DEEPEST live row through
+                # this chain — the gather and attention touch only the
+                # live head of the table, often FEWER columns than the
+                # dense layout's fixed max_len (masked-width invariance
+                # keeps tokens bitwise). Rows are right-aligned (no
+                # left pad: column i holds real token i), so the causal
+                # mask alone masks garbage columns and positions need
+                # no start offset. Sentinel table entries clip on
+                # gather (masked garbage) and drop on scatter (no block
+                # corrupted).
+                sub = table[:, :nb]
+
+                def body(carry, _):
+                    pool, idx, tok = carry
+                    kbuf = pool["k"][:, sub].reshape(
+                        n_layers, n_slots, nb * bs_kv, nh, hd)
+                    vbuf = pool["v"][:, sub].reshape(
+                        n_layers, n_slots, nb * bs_kv, nh, hd)
+                    cache = {"k": kbuf, "v": vbuf, "idx": idx}
+                    logits, cache = model.apply(
+                        variables, tok[:, None], cache=cache,
+                    )
+                    ntok = jnp.argmax(logits[:, -1], axis=-1)
+                    rows = jnp.arange(n_slots)
+                    blk = table[rows, idx // bs_kv]
+                    off = idx % bs_kv
+                    newk = cache["k"][:, rows, idx]
+                    newv = cache["v"][:, rows, idx]
+                    pool = {
+                        "k": pool["k"].at[:, blk, off].set(
+                            newk, mode="drop"),
+                        "v": pool["v"].at[:, blk, off].set(
+                            newv, mode="drop"),
+                    }
+                    return (pool, idx + 1, ntok), ntok
+
+                (pool, _, _), toks = lax.scan(
+                    body, (pool, idx, tok), None, length=k
+                )
+                return toks, pool
+
+            def _gathered(pool, ids):
+                # cached-prefix blocks -> the head of a private prefill
+                # cache (the copy that makes partial-block sharing
+                # copy-on-write: the sharer re-installs into blocks it
+                # owns, the donor block is never written). Sentinel ids
+                # clip to garbage the chunked prefill masks/overwrites.
+                kx = pool["k"][:, ids].reshape(n_layers, 1, w, nh, hd)
+                vx = pool["v"][:, ids].reshape(n_layers, 1, w, nh, hd)
+                pad = ((0, 0), (0, 0), (0, wp - w), (0, 0), (0, 0))
+                return jnp.pad(kx, pad), jnp.pad(vx, pad)
+
+            def _chunk_apply(variables, ck, cv, idx, ids, cols):
+                # one bounded prefill chunk, right-aligned: writes K/V
+                # at columns [idx, idx+width) of the private cache,
+                # where width = ids.shape[1] is the POWER-OF-2 BUCKET of
+                # this chunk's real token count (same compile-reuse
+                # trick as the dense path's prompt buckets: a 24-token
+                # suffix pays a 32-wide program, not a chunk-cap-wide
+                # one). ``cols`` (static, bucketed >= idx+width) bounds
+                # the attention to the LIVE head of the buffer — every
+                # column past it is causally masked garbage anyway, so
+                # slicing changes nothing but the wasted FLOPs. The tail
+                # of the chunk is zero-padded on the right; pad queries
+                # produce garbage columns PAST every real position, so
+                # the causal mask hides them until real writes overwrite
+                # them — no attention_mask needed (vs the dense path's
+                # left-pad masking).
+                positions = jnp.minimum(
+                    idx + jnp.arange(ids.shape[1])[None, :], max_pos)
+                cache = {"k": ck[:, :, :cols], "v": cv[:, :, :cols],
+                         "idx": idx}
+                logits, cache = model.apply(
+                    variables, ids, cache=cache, positions=positions,
+                )
+                ck = ck.at[:, :, :cols].set(cache["k"])
+                cv = cv.at[:, :, :cols].set(cache["v"])
+                return logits, ck, cv
+
+            def _installed(pool, ck, cv, ids):
+                # private prefill cache -> the slot's OWNED pool blocks.
+                # ids carries the sentinel at shared-prefix positions
+                # (their content already lives in the shared blocks) and
+                # past the covered span: those writes drop.
+                kv = ck[:, 0, :w].reshape(n_layers, mb, bs_kv, nh, hd)
+                vv = cv[:, 0, :w].reshape(n_layers, mb, bs_kv, nh, hd)
+                return {
+                    "k": pool["k"].at[:, ids].set(kv, mode="drop"),
+                    "v": pool["v"].at[:, ids].set(vv, mode="drop"),
+                }
+
+            # Four fused chunk programs so a prefill pays the minimum
+            # dispatch count (dispatch gap dominates small programs —
+            # the ISSUE 3 lesson applied to admission): the FIRST chunk
+            # fuses the prefix gather, the FINAL chunk fuses the block
+            # install, so a suffix that fits one chunk is ONE device
+            # dispatch end to end (vs dense's prefill + scatter pair).
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               static_argnums=(6,))
+            def _chunk_one(variables, pool, gids, idx, ids, inst, cols):
+                ck, cv = _gathered(pool, gids)
+                logits, ck, cv = _chunk_apply(
+                    variables, ck, cv, idx, ids, cols)
+                return logits, _installed(pool, ck, cv, inst)
+
+            @functools.partial(jax.jit, static_argnums=(5,))
+            def _chunk_first(variables, pool, gids, idx, ids, cols):
+                ck, cv = _gathered(pool, gids)
+                return _chunk_apply(variables, ck, cv, idx, ids, cols)
+
+            @functools.partial(jax.jit, donate_argnums=(1, 2),
+                               static_argnums=(5,))
+            def _chunk_mid(variables, ck, cv, idx, ids, cols):
+                return _chunk_apply(variables, ck, cv, idx, ids, cols)
+
+            # (ck/cv are deliberately NOT donated here or in _chunk_one:
+            # no output shares their shape, so donation could not alias
+            # — jax would warn "donated buffers were not usable" on
+            # every compile and free nothing earlier; they die on the
+            # host right after the call regardless)
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               static_argnums=(7,))
+            def _chunk_final(variables, pool, ck, cv, idx, ids, inst,
+                             cols):
+                logits, ck, cv = _chunk_apply(
+                    variables, ck, cv, idx, ids, cols)
+                return logits, _installed(pool, ck, cv, inst)
+
+            self._paged_step_fn = _paged_step
+            self._chunk_one_fn = _chunk_one
+            self._chunk_first_fn = _chunk_first
+            self._chunk_mid_fn = _chunk_mid
+            self._chunk_final_fn = _chunk_final
+        else:
+            self._cache = init_cache(
+                config, n_slots, max_len, per_slot=True)
+            self._start = np.zeros((n_slots,), np.int32)
 
         @jax.jit
         def _prefill(variables, ids, mask):
@@ -253,13 +549,36 @@ class ContinuousGPTEngine:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}"
             )
-        lp = pick_bucket(len(prompt), self._len_buckets)
-        if lp + max_new_tokens > self.max_len:
-            raise ValueError(
-                f"prompt bucket {lp} + max_new_tokens {max_new_tokens} "
-                f"exceeds cache max_len {self.max_len}: raise max_len or "
-                "shorten the request"
-            )
+        if self.kv_layout == "paged":
+            # the paged layout stores tokens unpadded, so the true
+            # per-request bound is the RAW length (dense pays the
+            # prompt-length bucket) — and the pool: a request whose
+            # worst-case block count exceeds the whole pool can never
+            # fit and is rejected loudly; one that merely cannot fit
+            # NOW is admitted and deferred at tick time.
+            if len(prompt) + max_new_tokens > self.max_len:
+                raise ValueError(
+                    f"prompt {len(prompt)} + max_new_tokens "
+                    f"{max_new_tokens} exceeds cache max_len "
+                    f"{self.max_len}: raise max_len or shorten the "
+                    "request"
+                )
+            need = -(-(len(prompt) + max_new_tokens) // self._kv_bs)
+            if need > self._pool.n_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool holds "
+                    f"{self._pool.n_blocks}: it can never fit — raise "
+                    "kv_blocks or shorten the request"
+                )
+        else:
+            lp = pick_bucket(len(prompt), self._len_buckets)
+            if lp + max_new_tokens > self.max_len:
+                raise ValueError(
+                    f"prompt bucket {lp} + max_new_tokens "
+                    f"{max_new_tokens} exceeds cache max_len "
+                    f"{self.max_len}: raise max_len or shorten the "
+                    "request"
+                )
         return self.queue.submit(
             GenRequest(prompt, max_new_tokens), timeout_s=timeout_s
         )
@@ -284,7 +603,8 @@ class ContinuousGPTEngine:
         if self._thread is not None:
             self._thread.join(timeout_s)
         elif drain:  # manual-tick mode: drain inline
-            while self.queue.depth > 0 or self._inflight:
+            while (self.queue.depth > 0 or self._inflight
+                   or self._prefilling):
                 self.tick()
         self._stop.set()
         # join timeout or a crashed loop may leave requests queued: no
@@ -293,6 +613,8 @@ class ContinuousGPTEngine:
         with self._lock:
             self._fail_inflight(EngineClosedError("engine shut down"))
         self._obs.close(drain=drain)
+        if self.kv_layout == "paged":
+            self._pool.close()
 
     def _loop(self) -> None:
         try:
@@ -300,7 +622,8 @@ class ContinuousGPTEngine:
                 did_work = self.tick()
                 if self.queue.closed and not did_work:
                     with self._lock:
-                        if self.queue.depth == 0 and not self._inflight:
+                        if (self.queue.depth == 0 and not self._inflight
+                                and not self._prefilling):
                             return  # graceful drain complete
             # non-graceful: surviving inflight failed by close()
         except BaseException as e:
@@ -316,7 +639,8 @@ class ContinuousGPTEngine:
 
     # -- one scheduling quantum ---------------------------------------------
     def tick(self) -> bool:
-        """Admit into free slots, advance every live row one token,
+        """Admit into free slots, advance chunked prefills by at most
+        ``prefill_chunk`` tokens, advance every live row one token,
         retire finished rows. Returns True if any work happened (False =
         idle tick). Thread-safe; the background loop is just
         ``while True: tick()``."""
@@ -324,13 +648,17 @@ class ContinuousGPTEngine:
             now = time.monotonic()
             self._expire_inflight(now)
             free = [s for s in range(self.n_slots)
-                    if s not in self._inflight]
+                    if s not in self._inflight
+                    and s not in self._prefilling]
             if free:
-                wait = 0.0 if self._inflight else self.idle_wait_s
-                for req in self.queue.take(len(free), wait):
+                wait = (0.0 if self._inflight or self._prefilling
+                        else self.idle_wait_s)
+                reqs = self.queue.take(len(free), wait)
+                deferred = False
+                for i, req in enumerate(reqs):
                     slot = free.pop(0)
                     try:
-                        self._admit(slot, req)
+                        admitted = self._admit(slot, req)
                     except Exception as e:
                         # take() already moved this Future to RUNNING, so
                         # nobody else can resolve it: a failed admission
@@ -338,30 +666,79 @@ class ContinuousGPTEngine:
                         # error, never the engine's — the slot stays free
                         # and the loop keeps serving
                         free.insert(0, slot)
-                        if not req.future.done():
-                            self._record_request_span(
-                                req, time.monotonic(), ok=False,
-                                tokens=0, error=e)
-                            req.future.set_exception(e)
-                            record_request_failure(
-                                e, request_id=req.request_id)
-                            self.metrics.record_request(
-                                now - req.enqueued, ok=False
-                            )
+                        self._fail_request(req, e, tokens=0)
+                        continue
+                    if not admitted:
+                        # pool exhausted: defer this request AND every
+                        # later one taken this tick back to the queue
+                        # head, in order — deferral never reorders
+                        # accepted traffic (a later arrival must not
+                        # grab the blocks the deferred one is owed)
+                        free.insert(0, slot)
+                        self._defer(reqs[i:])
+                        deferred = True
+                        break
+                if not deferred and self._defer_streak:
+                    # free slots existed and nothing deferred this tick
+                    # (the deferred work admitted, or left the queue —
+                    # e.g. expired): the exhaustion episode is over. A
+                    # streak must never outlive the pressure, or an
+                    # idle, recovered engine would read degraded
+                    # forever and the next real incident would miss its
+                    # postmortem trigger.
+                    self._defer_streak = 0
             else:
                 self.queue.sweep_expired()  # deadlines don't wait for slots
-            if not self._inflight:
-                return False
-            self._decode_step()
-            return True
+            did_work = False
+            if self._prefilling:
+                self._prefill_tick()
+                did_work = True
+            if self._inflight:
+                self._decode_step()
+                did_work = True
+            return did_work
 
-    def _admit(self, slot: int, req: Request) -> None:
+    def _defer(self, reqs: "list[Request]") -> None:
+        """KV pool exhaustion: re-queue in order, count the streak, and
+        after ``_EXHAUST_DUMP_STREAK`` consecutive deferrals hand the
+        flight recorder a postmortem trigger (providers capture the
+        pool state). Self-recovering: blocks free as slots retire."""
+        self.queue.requeue(reqs)
+        self._deferrals += 1
+        self._defer_streak += 1
+        self._pool.record_deferral()
+        flight_mod.record_event(
+            "kv.admission_deferred",
+            engine=getattr(self._obs, "name", None),
+            request_id=reqs[0].request_id,
+            deferred=len(reqs),
+            streak=self._defer_streak,
+            blocks_free=self._pool.free_count,
+            blocks_total=self._pool.n_blocks,
+        )
+        if self._defer_streak == _EXHAUST_DUMP_STREAK:
+            flight_mod.trigger_dump(
+                "kv.pool_exhausted",
+                streak=self._defer_streak,
+                blocks_total=self._pool.n_blocks,
+            )
+
+    def _admit(self, slot: int, req: Request) -> bool:
+        """Place one taken request into ``slot``. Returns False when the
+        paged block pool cannot back it right now (caller defers)."""
+        if self.kv_layout == "paged":
+            return self._admit_paged(slot, req)
+        self._admit_dense(slot, req)
+        return True
+
+    def _admit_dense(self, slot: int, req: Request) -> None:
         import jax.numpy as jnp
 
         from sparkdl_tpu.runtime.batching import pick_bucket
 
         gen: GenRequest = req.payload
         lp = pick_bucket(len(gen.prompt), self._len_buckets)
+        t0 = time.perf_counter()
         with span("serving.prefill", parent=req.trace_ctx,
                   prompt_len=len(gen.prompt), bucket=lp, slot=slot,
                   request_id=req.request_id):
@@ -376,12 +753,198 @@ class ContinuousGPTEngine:
                 self._cache, row, jnp.asarray(slot, jnp.int32)
             )
             first = int(tok[0])
+        self._prefill_seconds += time.perf_counter() - t0
         self._start[slot] = lp - len(gen.prompt)
         self._last_tok[slot] = first
         flight = _InFlight(req, [first], gen.max_new_tokens)
         self._inflight[slot] = flight
         if self._is_done(flight):  # max_new_tokens=1, or instant eos
             self._complete(slot)
+
+    # -- paged admission + chunked prefill -----------------------------------
+    def _admit_paged(self, slot: int, req: Request) -> bool:
+        """Match the longest cached prefix, allocate the request's
+        worst-case remaining blocks up front (so decode can never hit
+        mid-stream exhaustion), and queue the suffix for chunked
+        prefill. False = pool exhausted right now (defer)."""
+        import jax.numpy as jnp
+
+        gen: GenRequest = req.payload
+        prompt = np.asarray(gen.prompt, np.int32)
+        plen = len(prompt)
+        toks = tuple(int(t) for t in prompt)
+        nb_total = -(-(plen + gen.max_new_tokens) // self._kv_bs)
+        # the last prompt token must always prefill — the cache holds
+        # K/V, not the logits that seed decode
+        m = self._prefix.match(toks[:-1])
+        matched = (m.full_blocks
+                   + ([m.partial_block] if m.partial_block is not None
+                      else []))
+        try:
+            owned = self._alloc_blocks(nb_total - len(m.full_blocks))
+        except Exception as e:
+            # an injected kv.alloc fault (chaos harness) or allocator
+            # error is exhaustion, not a request error: defer, recover
+            flight_mod.record_event(
+                "kv.alloc_error", error=type(e).__name__,
+                request_id=req.request_id)
+            owned = None
+        if owned is None:
+            self._prefix.release(matched)
+            return False
+        # the first chunk will gather the cached prefix into the private
+        # prefill cache (also the COW copy of a partial tail block);
+        # sentinel entries are masked garbage, so no-hit = fresh cache.
+        # The partial block keeps its extra reference until that gather
+        # has been DISPATCHED (releasing it now would let an eviction +
+        # realloc overwrite it before the copy).
+        gids = np.full((self._mb,), self._pool.sentinel, np.int32)
+        gids[:len(m.full_blocks)] = m.full_blocks
+        if m.partial_block is not None:
+            gids[len(m.full_blocks)] = m.partial_block
+        n_shared = len(m.full_blocks)
+        inst = np.full((self._mb,), self._pool.sentinel, np.int32)
+        inst[n_shared:n_shared + len(owned)] = owned
+        self._prefix.record_lookup(m.hit_tokens, plen - m.hit_tokens)
+        if m.hit_tokens:
+            flight_mod.record_event(
+                "kv.prefix_hit", request_id=req.request_id,
+                hit_tokens=m.hit_tokens, prompt_tokens=plen)
+        self._prefilling[slot] = _Prefill(
+            req=req, prompt=prompt, max_new=gen.max_new_tokens,
+            pos=m.hit_tokens, hit=m.hit_tokens,
+            shared=m.full_blocks, owned=owned,
+            gather_ids=gids, install_ids=inst,
+            cow_block=m.partial_block,
+        )
+        self._defer_streak = 0
+        return True
+
+    def _alloc_blocks(self, n: int) -> "list[int] | None":
+        got = self._pool.allocate(n)
+        if got is None:
+            short = n - self._pool.free_count
+            if self._prefix.evict(short) >= short:
+                got = self._pool.allocate(n)
+        return got
+
+    def _prefill_tick(self) -> None:
+        """Advance chunked prefills by at most ``prefill_chunk`` REAL
+        tokens this tick, round-robin across prefilling slots — the
+        bound that keeps a long prompt from freezing in-flight decode
+        latency (several short prompts fit one tick's budget; a long
+        one takes exactly one chunk per tick)."""
+        budget = self.prefill_chunk
+        slots = sorted(self._prefilling)
+        if len(slots) > 1:
+            pivot = self._prefill_rr % len(slots)
+            slots = slots[pivot:] + slots[:pivot]
+        self._prefill_rr += 1
+        tick_tokens = 0
+        for slot in slots:
+            st = self._prefilling[slot]
+            r = min(self.prefill_chunk, len(st.prompt) - st.pos)
+            if r > budget:
+                continue  # over this tick's budget: next tick
+            budget -= r
+            tick_tokens += r
+            self._prefill_chunk_step(slot, st, r)
+            if budget <= 0:
+                break
+        self._max_tick_prefill_tokens = max(
+            self._max_tick_prefill_tokens, tick_tokens)
+
+    def _prefill_chunk_step(self, slot: int, st: _Prefill,
+                            r: int) -> None:
+        import jax.numpy as jnp
+
+        c0 = st.pos
+        first = st.ck is None
+        final = c0 + r == len(st.prompt)
+        from sparkdl_tpu.runtime.batching import pow2_bucket
+
+        # chunk-program width: power-of-2 bucket of the real token
+        # count (capped by the budget) — compile reuse without paying
+        # the full budget width for a short suffix
+        wc = pow2_bucket(r, 8, self._chunk_cap)
+        ids = np.zeros((1, wc), np.int32)
+        ids[0, :r] = st.prompt[c0:c0 + r]
+        # static attention width: bucket of the live buffer head — the
+        # program attends over [0, cols) instead of the whole private
+        # cache (everything past idx+wc is causally masked garbage)
+        cols = pow2_bucket(c0 + wc, 8, self._wp)
+        idx = jnp.asarray(c0, jnp.int32)
+        ids = jnp.asarray(ids)
+        t0 = time.perf_counter()
+        with span("serving.prefill_chunk", parent=st.req.trace_ctx,
+                  request_id=st.req.request_id, slot=slot,
+                  start=c0, tokens=r, first=first, final=final):
+            if first and final:
+                logits, self._pool_kv = self._chunk_one_fn(
+                    self.variables, self._pool_kv,
+                    jnp.asarray(st.gather_ids), idx, ids,
+                    jnp.asarray(st.install_ids), cols)
+            elif first:
+                logits, st.ck, st.cv = self._chunk_first_fn(
+                    self.variables, self._pool_kv,
+                    jnp.asarray(st.gather_ids), idx, ids, cols)
+            elif final:
+                logits, self._pool_kv = self._chunk_final_fn(
+                    self.variables, self._pool_kv, st.ck, st.cv,
+                    idx, ids, jnp.asarray(st.install_ids), cols)
+                st.ck = st.cv = None
+            else:
+                logits, st.ck, st.cv = self._chunk_mid_fn(
+                    self.variables, st.ck, st.cv, idx, ids, cols)
+        if first and st.cow_block is not None:
+            # the gather is dispatched: the COW copy is sequenced before
+            # any later overwrite of the source block — drop the hold
+            self._prefix.release([st.cow_block])
+            st.cow_block = None
+        st.pos += r
+        st.chunks += 1
+        self._prefill_chunks += 1
+        _M_PREFILL_CHUNKS.inc()
+        if final:
+            # the chunk's last REAL column seeds decode (argmax on
+            # device: the same op the oracle's generate uses)
+            self._finish_prefill(slot, st, int(jnp.argmax(logits[0, r - 1])))
+        self._prefill_seconds += time.perf_counter() - t0
+
+    def _finish_prefill(self, slot: int, st: _Prefill,
+                        first: int) -> None:
+        n_shared = len(st.shared)
+        nb_total = n_shared + len(st.owned)
+        row = np.full((self._mb,), self._pool.sentinel, np.int32)
+        row[:n_shared] = st.shared
+        row[n_shared:nb_total] = st.owned
+        self._table[slot] = row
+        plen = len(st.prompt)
+        n_prompt_blocks = -(-plen // self._kv_bs)
+        self._prefix.register(
+            tuple(int(t) for t in st.prompt),
+            [int(b) for b in row[:n_prompt_blocks]],
+        )
+        self._pidx[slot] = plen
+        self._last_tok[slot] = first
+        del self._prefilling[slot]
+        flight = _InFlight(st.req, [first], st.max_new,
+                           blocks=st.shared + st.owned)
+        self._inflight[slot] = flight
+        if self._is_done(flight):  # max_new_tokens=1, or instant eos
+            self._complete(slot)
+
+    def _release_slot(self, slot: int,
+                      blocks: "list[int] | None") -> None:
+        """Return a retiring slot's table to sentinel and drop its block
+        references (registered prompt blocks stay cached for prefix
+        reuse; the rest free)."""
+        if self.kv_layout != "paged":
+            return
+        self._table[slot] = self._pool.sentinel
+        self._pidx[slot] = 0
+        if blocks:
+            self._prefix.release(blocks)
 
     def _decode_chain_len(self, now: float) -> int:
         """Tokens to fuse into the next decode dispatch.
@@ -437,7 +1000,25 @@ class ContinuousGPTEngine:
             # residual copy wait, not the decode program itself.
             import jax
 
-            if k == 1:
+            if self.kv_layout == "paged":
+                from sparkdl_tpu.runtime.batching import pow2_bucket
+
+                # static gather width: blocks covering the deepest live
+                # row through this whole chain (idx advances k), bucketed
+                # to a power of two for compile reuse, capped at the
+                # table width
+                need = max((self._pidx[s] for s in self._inflight),
+                           default=0) + k
+                nb = pow2_bucket(-(-need // self._kv_bs), 1, self._mb)
+                toks, self._pool_kv = self._paged_step_fn(
+                    self.variables, self._pool_kv,
+                    jnp.asarray(self._table), jnp.asarray(self._pidx),
+                    jnp.asarray(self._last_tok), k, nb,
+                )
+                fetch = start_fetch(toks, path="decode")
+                jax.block_until_ready(toks)
+                toks = np.asarray(fetch.result())
+            elif k == 1:
                 tok, self._cache = self._step_fn(
                     self.variables, self._cache,
                     jnp.asarray(self._last_tok), jnp.asarray(self._start),
@@ -458,6 +1039,7 @@ class ContinuousGPTEngine:
         record_dispatch("decode", k, wall)
         self._chain_policy.record(wall, k)
         self.metrics.record_batch(len(self._inflight), self.n_slots)
+        paged = self.kv_layout == "paged"
         for j in range(k):
             live = [s for s in self._inflight]
             if not live:
@@ -466,6 +1048,10 @@ class ContinuousGPTEngine:
                 flight = self._inflight[slot]
                 flight.produced.append(int(toks[j, slot]))
                 self._last_tok[slot] = toks[j, slot]
+                if paged:
+                    # one column written per decoded token: keep the
+                    # host block-table cursor in lockstep
+                    self._pidx[slot] += 1
                 if self._is_done(flight):
                     # eos (or budget) mid-chain: any later tokens the
                     # chain decoded for this row are simply dropped —
@@ -490,6 +1076,7 @@ class ContinuousGPTEngine:
 
     def _complete(self, slot: int) -> None:
         flight = self._inflight.pop(slot)
+        self._release_slot(slot, flight.blocks)
         now = time.monotonic()
         self._record_request_span(
             flight.req, now, ok=True, tokens=len(flight.produced))
@@ -498,39 +1085,55 @@ class ContinuousGPTEngine:
         )
         self.metrics.record_request(now - flight.req.enqueued, ok=True)
 
+    def _fail_request(self, req: Request, exc: Exception, *,
+                      tokens: int) -> None:
+        """The one failure sequence every retire-with-error path shares:
+        terminal span, Future exception, shed-load counter, latency
+        metric. Skips Futures already resolved elsewhere."""
+        if req.future.done():
+            return
+        now = time.monotonic()
+        self._record_request_span(
+            req, now, ok=False, tokens=tokens, error=exc)
+        req.future.set_exception(exc)
+        record_request_failure(exc, request_id=req.request_id)
+        self.metrics.record_request(now - req.enqueued, ok=False)
+
     def _expire_inflight(self, now: float) -> None:
         for slot in list(self._inflight):
             flight = self._inflight[slot]
             if flight.req.expired(now):
                 self._inflight.pop(slot)
-                exc = DeadlineExceededError(
-                    "deadline exceeded mid-decode "
-                    f"({len(flight.produced)}/{flight.max_new} tokens)"
-                )
-                self._record_request_span(
-                    flight.req, now, ok=False,
-                    tokens=len(flight.produced), error=exc)
-                flight.req.future.set_exception(exc)
-                record_request_failure(
-                    exc, request_id=flight.req.request_id)
-                self.metrics.record_request(
-                    now - flight.req.enqueued, ok=False
-                )
+                self._release_slot(slot, flight.blocks)
+                self._fail_request(
+                    flight.req,
+                    DeadlineExceededError(
+                        "deadline exceeded mid-decode "
+                        f"({len(flight.produced)}/{flight.max_new} "
+                        "tokens)"),
+                    tokens=len(flight.produced))
+        for slot in list(self._prefilling):
+            st = self._prefilling[slot]
+            if st.req.expired(now):
+                self._prefilling.pop(slot)
+                self._release_slot(slot, st.all_blocks())
+                self._fail_request(
+                    st.req,
+                    DeadlineExceededError(
+                        "deadline exceeded mid-prefill "
+                        f"({st.pos}/{len(st.prompt)} prompt tokens)"),
+                    tokens=0)
 
     def _fail_inflight(self, exc: Exception) -> None:
         for slot in list(self._inflight):
             flight = self._inflight.pop(slot)
-            if not flight.req.future.done():
-                now = time.monotonic()
-                self._record_request_span(
-                    flight.req, now, ok=False,
-                    tokens=len(flight.produced), error=exc)
-                flight.req.future.set_exception(exc)
-                record_request_failure(
-                    exc, request_id=flight.req.request_id)
-                self.metrics.record_request(
-                    now - flight.req.enqueued, ok=False
-                )
+            self._release_slot(slot, flight.blocks)
+            self._fail_request(flight.req, exc,
+                               tokens=len(flight.produced))
+        for slot in list(self._prefilling):
+            st = self._prefilling.pop(slot)
+            self._release_slot(slot, st.all_blocks())
+            self._fail_request(st.req, exc, tokens=0)
 
     # -- introspection -------------------------------------------------------
     @property
@@ -544,26 +1147,55 @@ class ContinuousGPTEngine:
         return tracing.spans_for_trace(request_id)
 
     def inflight_request_ids(self) -> "list[int]":
-        """Ids of queued + decoding requests (postmortem input).
-        Best-effort: read without the engine lock."""
+        """Ids of queued + prefilling + decoding requests (postmortem
+        input). Best-effort: read without the engine lock."""
         out = self.queue.pending_request_ids()
         try:
             out.extend(f.req.request_id
                        for f in list(self._inflight.values()))
+            out.extend(s.req.request_id
+                       for s in list(self._prefilling.values()))
         except RuntimeError:  # pragma: no cover - mutation race
             pass
         return out
 
+    def _kv_snapshot(self) -> "dict[str, Any] | None":
+        if self.kv_layout != "paged":
+            return None
+        return {
+            "block_size": self._kv_bs,
+            "blocks_total": self._pool.n_blocks,
+            "blocks_used": self._pool.used_count,
+            "blocks_used_peak": self._pool.used_peak,
+            "blocks_cached": self._prefix.cached_blocks,
+            "prefix_hits": self._prefix.hit_tokens,
+            "prefix_misses": self._prefix.miss_tokens,
+            "prefix_evictions": self._prefix.evictions,
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_chunks": self._prefill_chunks,
+            "deferrals_total": self._deferrals,
+            "exhausted_streak": self._defer_streak,
+        }
+
     def _flight_context(self) -> dict:
         out = self.metrics.snapshot(self.queue)
         out["active_slots"] = self.active_slots
+        out["prefilling_slots"] = len(self._prefilling)
         out["inflight_request_ids"] = self.inflight_request_ids()
+        kv = self._kv_snapshot()
+        if kv is not None:
+            # healthz_report aggregates this shape: a nonzero
+            # exhaustion streak reads as degraded (self-recovering)
+            out["kv_pool"] = kv
         return out
 
     def snapshot(self) -> dict[str, Any]:
         out = self.metrics.snapshot(self.queue)
         out["active_slots"] = self.active_slots
         out["n_slots"] = self.n_slots
+        out["kv_layout"] = self.kv_layout
+        out["prefill_seconds"] = self._prefill_seconds
+        out["kv"] = self._kv_snapshot()
         out["slo"] = (self.slo_tracker.sample()
                       if self.slo_tracker is not None else None)
         return out

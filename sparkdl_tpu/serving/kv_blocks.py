@@ -1,0 +1,178 @@
+"""Block-paged KV allocation for continuous GPT serving.
+
+The dense continuous engine holds one ``[layers, n_slots, max_len, H, D]``
+cache, so its memory contract is ``n_slots x max_len`` worst-case columns
+whether or not tokens exist. This module is the host-side half of the
+paged layout (ROADMAP item 4, the vLLM idea): the device holds one
+``[layers, n_blocks, block_size, H, D]`` pool
+(:func:`~sparkdl_tpu.models.gpt.init_block_pool`), each serving slot maps
+its logical columns onto pool blocks through a per-slot block table, and
+THIS class owns the free list and refcounts — so
+
+* capacity is bounded by live tokens (``blocks_used x block_size``), not
+  by ``n_slots x max_len``;
+* a physical block can back many slots at once (refcounted — how
+  :mod:`~sparkdl_tpu.serving.prefix_cache` shares prompt prefixes);
+* admission against an exhausted pool *defers* (the engine re-queues the
+  request and retries as slots retire) instead of erroring.
+
+Bookkeeping is plain Python under the engine lock — allocation is a
+host-side scheduling decision, never device work. The pool publishes
+``sparkdl_kv_blocks_total`` / ``sparkdl_kv_blocks_used`` gauges as
+delta contributions (several pools may live in one process; each adds
+its share instead of clobbering the others — the RequestQueue depth
+pattern) and carries the ``kv.alloc`` fault site so the chaos harness
+can simulate exhaustion deterministically.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterable, Optional
+
+from sparkdl_tpu.observability.registry import GaugeShare, registry
+
+_M_TOTAL = registry().gauge(
+    "sparkdl_kv_blocks_total",
+    "KV pool capacity in blocks, all pools")
+_M_USED = registry().gauge(
+    "sparkdl_kv_blocks_used",
+    "allocated KV blocks (live slots + cached prefixes), all pools")
+_M_DEFERRED = registry().counter(
+    "sparkdl_kv_admission_deferred_total",
+    "admissions re-queued because the KV block pool was exhausted")
+
+
+class KVBlockPool:
+    """Free list + refcounts over ``n_blocks`` physical KV blocks.
+
+    ``allocate`` hands out refcount-1 block ids (or None — the caller
+    defers); ``ref``/``deref`` track sharing; a block whose refcount
+    hits zero is NOT auto-freed — the caller (the prefix cache) decides
+    whether it goes back to the free list (:meth:`release`) or stays
+    resident as an evictable cached prefix. ``sentinel`` (== n_blocks,
+    one past the last valid id) marks empty block-table entries: the
+    device-side gather clips it and the scatter drops it, so an
+    unoccupied table entry can never read or corrupt a live block.
+    """
+
+    def __init__(self, n_blocks: int, block_size: int):
+        if n_blocks < 1:
+            raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+        if block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {block_size}")
+        self.n_blocks = n_blocks
+        self.block_size = block_size
+        self._free: "collections.deque[int]" = collections.deque(
+            range(n_blocks))
+        self._is_free = [True] * n_blocks
+        self._ref = [0] * n_blocks
+        #: high-water mark of :attr:`used_count` — the number that sizes
+        #: a pool (end-of-run used_count has already fallen back to the
+        #: cached-prefix residual)
+        self.used_peak = 0
+        self._closed = False
+        self._g_total = GaugeShare(_M_TOTAL)
+        self._g_used = GaugeShare(_M_USED)
+        self._g_total.set(n_blocks)
+        self._g_used.set(0)
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def sentinel(self) -> int:
+        """Block-table id meaning "no block": gather clips, scatter drops."""
+        return self.n_blocks
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        """Blocks off the free list: live slots + cached prefixes."""
+        return self.n_blocks - len(self._free)
+
+    def refcount(self, block_id: int) -> int:
+        return self._ref[block_id]
+
+    # -- allocation ----------------------------------------------------------
+    def allocate(self, n: int) -> "Optional[list[int]]":
+        """Pop ``n`` blocks at refcount 1, or None when the free list is
+        short (the caller defers — pool exhaustion is backpressure, not
+        an error). ``kv.alloc`` is a fault site: an armed plan makes
+        exhaustion injectable for the chaos harness."""
+        from sparkdl_tpu.reliability.faults import fault_point
+
+        fault_point("kv.alloc")
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        out = [self._free.popleft() for _ in range(n)]
+        for bid in out:
+            self._ref[bid] = 1
+            self._is_free[bid] = False
+        self._update_gauges()
+        return out
+
+    def ref(self, block_ids: Iterable[int]) -> None:
+        """Add one reference per id. Refcount 0 is legal here — that is
+        a CACHED block (off the free list, trie-registered) being
+        resurrected by a prefix match; only free-list blocks reject."""
+        for bid in block_ids:
+            if self._is_free[bid]:
+                raise RuntimeError(
+                    f"ref of free block {bid}: allocator bookkeeping "
+                    "corrupt"
+                )
+            self._ref[bid] += 1
+
+    def deref(self, block_ids: Iterable[int]) -> "list[int]":
+        """Drop one reference per id; returns the ids that hit zero (the
+        caller frees or keeps them as cached prefixes)."""
+        zeroed = []
+        for bid in block_ids:
+            if self._ref[bid] < 1:
+                raise RuntimeError(
+                    f"deref of free block {bid}: double release"
+                )
+            self._ref[bid] -= 1
+            if self._ref[bid] == 0:
+                zeroed.append(bid)
+        return zeroed
+
+    def release(self, block_ids: Iterable[int]) -> None:
+        """Return refcount-0 blocks to the free list."""
+        for bid in block_ids:
+            if self._ref[bid] != 0:
+                raise RuntimeError(
+                    f"release of block {bid} at refcount "
+                    f"{self._ref[bid]}: still referenced"
+                )
+            if self._is_free[bid]:
+                raise RuntimeError(f"double free of block {bid}")
+            self._free.append(bid)
+            self._is_free[bid] = True
+        self._update_gauges()
+
+    def record_deferral(self) -> None:
+        _M_DEFERRED.inc()
+
+    def _update_gauges(self) -> None:
+        used = self.used_count
+        if used > self.used_peak:
+            self.used_peak = used
+        self._g_used.set(used)
+        # re-assert capacity too: a registry().reset() mid-life (test
+        # isolation) zeroes the gauge, and a total that is only pushed
+        # at construction would stay 0 while used recovers
+        self._g_total.set(0 if self._closed else self.n_blocks)
+
+    def close(self) -> None:
+        """Retract this pool's gauge contributions (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._g_total.set(0)
+        self._g_used.set(0)

@@ -1,0 +1,256 @@
+"""Radix-style prefix cache over paged KV blocks.
+
+Chat/RAG traffic shares prompt prefixes (system prompts, retrieved
+documents): the K/V a prefill computes for those tokens is a pure
+function of the token ids before them, so a second request with the
+same prefix can reuse the first one's blocks and prefill only its
+suffix — prefill FLOPs drop in proportion to the hit rate, the dominant
+serving win for shared-prompt traffic (ROADMAP item 4).
+
+Structure: a trie keyed by **full-block token tuples** (``block_size``
+tokens per edge), so a path from the root spells out an exact token
+prefix and each node owns the physical block holding that span's K/V.
+A node additionally carries *partial entries* — tail blocks whose
+prompt filled only ``q < block_size`` slots — which are shared by
+**copy-on-write**: a matching request gathers the partial block's
+content into its own private prefill cache and re-installs it into a
+block IT owns, so the donor (possibly still decoding into that very
+block past offset ``q``) is never written by a sibling.
+
+Lifetime: matched blocks are refcounted through
+:class:`~sparkdl_tpu.serving.kv_blocks.KVBlockPool`; a registered block
+whose refcount drops to zero stays resident as an evictable cache entry
+rather than returning to the free list. Eviction is LRU over
+refcount-zero **leaves** (evicting a parent before its children would
+leave an unmatchable dangling suffix), invoked by the engine when
+allocation comes up short. Unregistered blocks free immediately at
+refcount zero.
+
+All bookkeeping runs under the engine lock — host-side scheduling,
+no device work. Spine metrics: ``sparkdl_prefix_hits_total`` /
+``sparkdl_prefix_misses_total`` count prompt TOKENS served from cache
+vs prefilled, ``sparkdl_prefix_evictions_total`` counts blocks evicted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Optional
+
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.serving.kv_blocks import KVBlockPool
+
+_M_HITS = registry().counter(
+    "sparkdl_prefix_hits_total",
+    "prompt tokens served from cached KV prefixes (prefill skipped)")
+_M_MISSES = registry().counter(
+    "sparkdl_prefix_misses_total",
+    "prompt tokens prefilled from scratch")
+_M_EVICTIONS = registry().counter(
+    "sparkdl_prefix_evictions_total",
+    "cached prefix blocks evicted (LRU, refcount-0 leaves)")
+
+
+@dataclasses.dataclass
+class _Partial:
+    """A cached tail block holding ``len(tokens) < block_size`` prompt
+    tokens (shared copy-on-write, never in a sharer's block table)."""
+
+    tokens: tuple
+    block_id: int
+    parent: Any
+    stamp: int
+
+
+class _Node:
+    """One full cached block: ``key`` is its ``block_size``-token span,
+    the root-to-node path spells the whole prefix."""
+
+    __slots__ = ("key", "block_id", "parent", "children", "partials",
+                 "stamp")
+
+    def __init__(self, key, block_id, parent, stamp):
+        self.key = key
+        self.block_id = block_id
+        self.parent = parent
+        self.children: "dict[tuple, _Node]" = {}
+        self.partials: "list[_Partial]" = []
+        self.stamp = stamp
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixMatch:
+    """Longest cached prefix of one prompt. ``full_blocks`` go straight
+    into the slot's block table (shared, read-only — decode never
+    writes columns below the prompt length); ``partial_block`` is
+    gathered then re-installed copy-on-write. All matched blocks are
+    already refcounted; release through :meth:`PrefixCache.release`
+    (full) and a single release of the partial once copied."""
+
+    full_blocks: "list[int]"
+    partial_block: "Optional[int]"
+    partial_tokens: int
+    hit_tokens: int
+
+
+class PrefixCache:
+    """Token-trie prefix index over a :class:`KVBlockPool`."""
+
+    def __init__(self, pool: KVBlockPool):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self._clock = itertools.count(1)
+        self._root = _Node(None, -1, None, 0)
+        #: block_id -> _Node | _Partial for every trie-registered block
+        self._registered: "dict[int, Any]" = {}
+        # engine-visible counters (the registry families are process
+        # totals; benches/snapshots want this engine's share)
+        self.hit_tokens = 0
+        self.miss_tokens = 0
+        self.evictions = 0
+
+    # -- lookup --------------------------------------------------------------
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._registered)
+
+    def match(self, tokens: "tuple[int, ...]") -> PrefixMatch:
+        """Longest cached prefix of ``tokens``; increfs every matched
+        block so concurrent eviction cannot reclaim it before the
+        caller installs/copies. Callers pass the prompt MINUS its last
+        token: the token feeding the first decode step must always be
+        prefilled, because the cache holds K/V, not logits."""
+        bs = self.block_size
+        node = self._root
+        full: "list[int]" = []
+        i = 0
+        while len(tokens) - i >= bs:
+            child = node.children.get(tokens[i:i + bs])
+            if child is None:
+                break
+            full.append(child.block_id)
+            node = child
+            node.stamp = next(self._clock)
+            i += bs
+        best: "Optional[_Partial]" = None
+        best_q = 0
+        rest = tokens[i:]
+        for p in node.partials:
+            q = _common_prefix(p.tokens, rest)
+            if q > best_q:
+                best, best_q = p, q
+        self.pool.ref(full)
+        partial_id = None
+        if best is not None and best_q > 0:
+            partial_id = best.block_id
+            self.pool.ref([partial_id])
+            best.stamp = next(self._clock)
+        return PrefixMatch(full, partial_id, best_q, i + best_q)
+
+    def record_lookup(self, hit_tokens: int, miss_tokens: int) -> None:
+        """Land one admission's hit/miss split (prompt tokens) in the
+        spine + the engine-local counters."""
+        if hit_tokens:
+            _M_HITS.inc(hit_tokens)
+            self.hit_tokens += hit_tokens
+        if miss_tokens:
+            _M_MISSES.inc(miss_tokens)
+            self.miss_tokens += miss_tokens
+
+    # -- registration --------------------------------------------------------
+    def register(self, tokens: "tuple[int, ...]",
+                 block_ids: "list[int]") -> None:
+        """Index a freshly prefilled prompt: ``block_ids[i]`` holds
+        tokens ``[i*bs, (i+1)*bs)`` (the slot's table prefix — shared
+        blocks walk existing nodes, owned blocks become new entries).
+        A registered block survives refcount zero as an evictable
+        cache entry instead of freeing."""
+        bs = self.block_size
+        node = self._root
+        n_full = len(tokens) // bs
+        for i in range(n_full):
+            key = tokens[i * bs:(i + 1) * bs]
+            child = node.children.get(key)
+            if child is None:
+                bid = block_ids[i]
+                child = _Node(key, bid, node, next(self._clock))
+                node.children[key] = child
+                self._registered[bid] = child
+            node = child
+            node.stamp = next(self._clock)
+        tail = tokens[n_full * bs:]
+        if tail:
+            bid = block_ids[n_full]
+            if bid not in self._registered and not any(
+                    p.tokens == tail for p in node.partials):
+                p = _Partial(tail, bid, node, next(self._clock))
+                node.partials.append(p)
+                self._registered[bid] = p
+
+    # -- release / eviction --------------------------------------------------
+    def release(self, block_ids: "list[int]") -> None:
+        """Drop one reference per block; zero-ref blocks return to the
+        free list unless trie-registered (those stay cached until
+        evicted)."""
+        free_now = [bid for bid in self.pool.deref(block_ids)
+                    if bid not in self._registered]
+        if free_now:
+            self.pool.release(free_now)
+
+    def _evictable(self, bid: int, entry: Any) -> bool:
+        if self.pool.refcount(bid) != 0:
+            return False
+        if isinstance(entry, _Node) and (entry.children
+                                         or entry.partials):
+            return False  # interior node: children would dangle
+        return True
+
+    def evict(self, n: int) -> int:
+        """Free up to ``n`` cached blocks, LRU over refcount-0 leaves;
+        returns how many were freed. Evicting a leaf may expose its
+        parent as the next candidate, so pressure drains whole cold
+        paths tail-first. One candidate pass + a stamp heap: O(cached +
+        n log cached), not a full rescan per freed block — this runs
+        under the engine lock on the admission path."""
+        import heapq
+
+        heap = [(entry.stamp, bid)
+                for bid, entry in self._registered.items()
+                if self._evictable(bid, entry)]
+        heapq.heapify(heap)
+        freed = 0
+        while freed < n and heap:
+            stamp, bid = heapq.heappop(heap)
+            entry = self._registered.get(bid)
+            if entry is None or not self._evictable(bid, entry):
+                continue  # resurrected by a match since the pass
+            if entry.stamp != stamp:
+                # touched since queued: re-queue at its fresh stamp so
+                # LRU order stays honest (stamps only grow: terminates)
+                heapq.heappush(heap, (entry.stamp, bid))
+                continue
+            parent = entry.parent
+            if isinstance(entry, _Partial):
+                parent.partials.remove(entry)
+            else:
+                del parent.children[entry.key]
+            del self._registered[bid]
+            self.pool.release([bid])
+            _M_EVICTIONS.inc()
+            self.evictions += 1
+            freed += 1
+            # the eviction may have exposed its parent as a new leaf
+            if (parent is not self._root
+                    and parent.block_id in self._registered
+                    and self._evictable(parent.block_id, parent)):
+                heapq.heappush(heap, (parent.stamp, parent.block_id))
+        return freed
+
+
+def _common_prefix(a: tuple, b: tuple) -> int:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n

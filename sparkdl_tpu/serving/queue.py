@@ -22,7 +22,7 @@ from concurrent.futures import Future
 from typing import Any
 
 from sparkdl_tpu.observability import flight, tracing
-from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.observability.registry import GaugeShare, registry
 
 # Registry mirrors of the queue's own counters (ISSUE 2: the spine sees
 # admission control without asking each engine for its snapshot). Family
@@ -40,6 +40,10 @@ _M_DEPTH = registry().gauge(
     "sparkdl_queue_depth", "currently queued requests, all queues")
 _M_WAIT = registry().histogram(
     "sparkdl_queue_wait_seconds", "queue wait, submit to take")
+_M_REQUEUED = registry().counter(
+    "sparkdl_queue_requeued_total",
+    "taken requests returned to the queue head (deferred admission, "
+    "e.g. KV block-pool exhaustion)")
 _M_FAILED = registry().counter(
     "sparkdl_requests_failed_total",
     "accepted requests that resolved with an error, by reason "
@@ -113,6 +117,11 @@ class Request:
     #: off or a span-less caller): its trace id rides the queue-wait
     #: span's links, joining the caller's trace to the request's
     submitter_ctx: "tracing.SpanContext | None" = None
+    #: True once take() moved the Future to RUNNING. A deferred request
+    #: (requeue()) comes back with ``started`` set, so the next take
+    #: skips the set_running handshake (a Future runs only once) and
+    #: the caller can no longer cancel it — it was already admitted.
+    started: bool = False
 
     def expired(self, now: float | None = None) -> bool:
         return (self.deadline is not None
@@ -120,12 +129,17 @@ class Request:
                 >= self.deadline)
 
     def fail_expired(self) -> None:
-        # a future the caller already cancelled cannot take an exception
-        if self.future.set_running_or_notify_cancel():
-            exc = DeadlineExceededError(
-                f"deadline exceeded after "
-                f"{time.monotonic() - self.enqueued:.3f}s in queue"
-            )
+        exc = DeadlineExceededError(
+            f"deadline exceeded after "
+            f"{time.monotonic() - self.enqueued:.3f}s in queue"
+        )
+        if self.started:
+            # already RUNNING (a deferred admission): fail directly
+            record_request_failure(exc, request_id=self.request_id)
+            self.future.set_exception(exc)
+        elif self.future.set_running_or_notify_cancel():
+            # a future the caller already cancelled cannot take an
+            # exception — the handshake filters those
             record_request_failure(exc, request_id=self.request_id)
             self.future.set_exception(exc)
 
@@ -147,30 +161,21 @@ class RequestQueue:
         self._dq: collections.deque[Request] = collections.deque()
         self._cv = threading.Condition()
         self._closed = False
-        #: depth last pushed to the shared gauge — the gauge carries the
-        #: SUM over all live queues, so each queue contributes deltas
-        #: rather than set() (which would clobber its neighbors). The
-        #: generation stamp detects registry().reset() wiping the gauge
-        #: under us (test isolation): the baseline restarts at 0.
-        self._reported_depth = 0
-        self._reported_gen = registry().generation
+        #: the gauge carries the SUM over all live queues: each queue
+        #: contributes deltas of its own depth (registry.GaugeShare —
+        #: the same reset-safe pattern the KV block pool uses)
+        self._depth_share = GaugeShare(_M_DEPTH)
         #: monotonically increasing counters (read under no lock: ints)
         self.submitted = 0
         self.rejected = 0
         self.expired = 0
         self.cancelled = 0
+        self.requeued = 0
 
     def _update_depth_locked(self) -> None:
         """Push this queue's depth change to the shared gauge as a delta
         (called under ``self._cv``)."""
-        gen = registry().generation
-        if gen != self._reported_gen:  # reset() zeroed our contribution
-            self._reported_depth = 0
-            self._reported_gen = gen
-        depth = len(self._dq)
-        if depth != self._reported_depth:
-            _M_DEPTH.inc(depth - self._reported_depth)
-            self._reported_depth = depth
+        self._depth_share.set(len(self._dq))
 
     @property
     def depth(self) -> int:
@@ -245,6 +250,7 @@ class RequestQueue:
             return []
         end = time.monotonic() + max_wait_s
         out: list[Request] = []
+        fresh: list[Request] = []
         with self._cv:
             while not self._dq and not self._closed:
                 remaining = end - time.monotonic()
@@ -261,13 +267,21 @@ class RequestQueue:
                     continue
                 # a caller that cancelled its Future no longer wants the
                 # result; set_running_or_notify_cancel is the handshake
-                if not req.future.set_running_or_notify_cancel():
-                    self.cancelled += 1
-                    _M_CANCELLED.inc()
-                    continue
+                # (skipped for requeued requests — already RUNNING)
+                if not req.started:
+                    if not req.future.set_running_or_notify_cancel():
+                        self.cancelled += 1
+                        _M_CANCELLED.inc()
+                        continue
+                    req.started = True
+                    fresh.append(req)
                 out.append(req)
             self._update_depth_locked()
-        for req in out:
+        # wait metrics/spans on the FIRST take only: a deferred request
+        # is retaken once per engine tick, and re-observing its
+        # cumulative wait each time would inflate the histogram and
+        # flood the span ring exactly during the exhaustion incident
+        for req in fresh:
             _M_WAIT.observe(now - req.enqueued)
             # retroactive span: the wait started at submit, long before
             # this instrumentation point, parented on the request's
@@ -300,7 +314,7 @@ class RequestQueue:
         with self._cv:
             while self._dq:
                 req = self._dq.popleft()
-                if req.future.set_running_or_notify_cancel():
+                if req.started or req.future.set_running_or_notify_cancel():
                     record_request_failure(exc, request_id=req.request_id)
                     req.future.set_exception(exc)
                 else:
@@ -309,6 +323,23 @@ class RequestQueue:
                 n += 1
             self._update_depth_locked()
         return n
+
+    def requeue(self, requests: "list[Request]") -> None:
+        """Return taken requests to the queue HEAD, in order — deferred
+        admission (the engine took them but cannot place them yet, e.g.
+        the KV block pool is exhausted). They are retaken ahead of
+        everything submitted after them, so deferral never reorders
+        accepted traffic. Works on a closed queue: the requests were
+        admitted before close() and close keeps queued work takeable."""
+        if not requests:
+            return
+        with self._cv:
+            for req in reversed(requests):
+                self._dq.appendleft(req)
+            self.requeued += len(requests)
+            _M_REQUEUED.inc(len(requests))
+            self._update_depth_locked()
+            self._cv.notify_all()
 
     def sweep_expired(self) -> None:
         """Fail every expired queued request now. take() sweeps anyway;

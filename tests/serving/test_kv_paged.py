@@ -1,0 +1,352 @@
+"""Paged KV cache: parity, prefix reuse, COW, eviction, deferral.
+
+The paged layout is a memory/scheduling decision, never a quality
+decision: every test here ultimately pins greedy tokens against the
+dense engine and the unbatched ``generate`` oracle, while asserting the
+paged machinery (block accounting, prefix hits, copy-on-write tail
+blocks, LRU eviction, deferred admission, chunk budgets) actually
+engaged.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel, generate
+from sparkdl_tpu.observability import tracing
+from sparkdl_tpu.observability.flight import healthz_report
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.serving import ContinuousGPTEngine
+
+MAX_LEN = 32
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    return cfg, model, variables
+
+
+def _oracle(model, variables, prompt, max_new):
+    out = generate(
+        model, variables, jnp.asarray([prompt], jnp.int32), max_new
+    )
+    return np.asarray(out[0, len(prompt):])
+
+
+def _engine(cfg, variables, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", MAX_LEN)
+    kw.setdefault("auto_start", False)
+    return ContinuousGPTEngine(cfg, variables, **kw)
+
+
+def _drain(eng, futs):
+    while not all(f.done() for f in futs):
+        eng.tick()
+
+
+def _counter(name):
+    fam = registry().snapshot().get(name)
+    if fam is None:
+        return 0.0
+    return sum(fam["values"].values())
+
+
+# -- parity ------------------------------------------------------------------
+
+def test_paged_bitwise_vs_dense_and_generate(bundle):
+    """Shared-prefix traffic through the paged engine must produce
+    greedy tokens bitwise-identical to BOTH the dense engine and the
+    unbatched oracle — across prefix hits, chunked prefill, and
+    mid-stream joins."""
+    cfg, model, variables = bundle
+    shared = [5, 3, 9, 2, 7, 11, 4, 8]
+    cases = [
+        (shared + [1, 6], 6),
+        (shared + [2, 2, 9], 5),   # prefix hit on the first case
+        ([6, 8, 6], 4),            # no shared prefix
+        (shared + [1, 6], 3),      # full-prompt hit (minus last token)
+    ]
+    outs = {}
+    for layout, kw in (
+        ("paged", dict(kv_block_size=4, prefill_chunk=4)),
+        ("dense", {}),
+    ):
+        eng = _engine(cfg, variables, kv_layout=layout, **kw)
+        futs = [eng.submit(p, n) for p, n in cases]
+        _drain(eng, futs)
+        eng.close()
+        outs[layout] = [f.result(timeout=0) for f in futs]
+    for (prompt, max_new), got_p, got_d in zip(
+            cases, outs["paged"], outs["dense"]):
+        want = _oracle(model, variables, prompt, max_new)
+        np.testing.assert_array_equal(
+            got_p, want, err_msg=f"paged diverged from oracle: {prompt}")
+        np.testing.assert_array_equal(
+            got_p, got_d, err_msg=f"paged diverged from dense: {prompt}")
+
+
+# -- prefix reuse ------------------------------------------------------------
+
+def test_prefix_hit_skips_prefill_of_cached_span(bundle):
+    """A prefix-hit admit must prefill ONLY the suffix: the hit lands
+    in sparkdl_prefix_hits_total and the request's trace carries
+    prefill_chunk spans covering exactly the un-cached tokens."""
+    cfg, model, variables = bundle
+    shared = [5, 3, 9, 2, 7, 11, 4, 8]
+    eng = _engine(cfg, variables, kv_block_size=4, prefill_chunk=4)
+    tracing.enable_tracing()
+    try:
+        hits0 = _counter("sparkdl_prefix_hits_total")
+        f1 = eng.submit(shared + [1, 6], 4)
+        _drain(eng, [f1])
+        assert _counter("sparkdl_prefix_hits_total") == hits0  # cold
+        f2 = eng.submit(shared + [2, 9], 4)
+        _drain(eng, [f2])
+        eng.close()
+        # prompt 10 tokens, cached span = 2 full blocks (8 tokens):
+        # full-block match only — the divergent suffix shares no
+        # partial content with the first prompt's tail block
+        assert _counter("sparkdl_prefix_hits_total") == hits0 + 8
+        assert eng._prefix.hit_tokens == 8
+        spans2 = [s for s in tracing.spans_for_trace(f2.request_id)
+                  if s["name"] == "serving.prefill_chunk"]
+        assert sum(s["args"]["tokens"] for s in spans2) == 2  # 10-8 cached
+        spans1 = [s for s in tracing.spans_for_trace(f1.request_id)
+                  if s["name"] == "serving.prefill_chunk"]
+        assert sum(s["args"]["tokens"] for s in spans1) == 10  # cold: all
+        np.testing.assert_array_equal(
+            f2.result(timeout=0),
+            _oracle(model, variables, shared + [2, 9], 4))
+    finally:
+        tracing.disable_tracing()
+        tracing.clear_trace()
+
+
+def test_cow_shared_partial_block_never_corrupts_sibling(bundle):
+    """B admits matching A's partially-filled tail block while A is
+    still DECODING into that very block: B must copy, not share the
+    writes — both decodes stay oracle-identical."""
+    cfg, model, variables = bundle
+    prefix = [5, 3, 9, 2, 7, 11]  # 6 tokens: block 0 full, block 1 has 2
+    eng = _engine(cfg, variables, kv_block_size=4, prefill_chunk=8)
+    fa = eng.submit(prefix, 8)
+    eng.tick()  # A admitted, prefilled, decoding into its tail block
+    eng.tick()
+    assert not fa.done()
+    fb = eng.submit(prefix + [1, 4], 6)  # matches block 0 + partial 2
+    _drain(eng, [fa, fb])
+    eng.close()
+    assert eng._prefix.hit_tokens == 4 + 2  # 1 full block + 2 partial
+    np.testing.assert_array_equal(
+        fa.result(timeout=0), _oracle(model, variables, prefix, 8),
+        err_msg="donor decode corrupted by COW sharer")
+    np.testing.assert_array_equal(
+        fb.result(timeout=0),
+        _oracle(model, variables, prefix + [1, 4], 6))
+
+
+def test_lru_eviction_under_pool_pressure(bundle):
+    """Distinct prompts past pool capacity: refcount-0 cached prefixes
+    must evict LRU so admission keeps succeeding, and correctness
+    survives block recycling."""
+    cfg, model, variables = bundle
+    rng = np.random.default_rng(3)
+    # 6 blocks of 8: each request needs 2, cached prefixes pile up
+    eng = _engine(cfg, variables, n_slots=1, kv_block_size=8,
+                  kv_blocks=6, prefill_chunk=8)
+    ev0 = _counter("sparkdl_prefix_evictions_total")
+    cases = []
+    for _ in range(6):
+        prompt = rng.integers(1, cfg.vocab_size, 7).tolist()
+        cases.append((prompt, 4))
+        fut = eng.submit(prompt, 4)
+        _drain(eng, [fut])
+        np.testing.assert_array_equal(
+            fut.result(timeout=0),
+            _oracle(model, variables, prompt, 4))
+    eng.close()
+    assert _counter("sparkdl_prefix_evictions_total") > ev0
+    assert eng._prefix.evictions > 0
+
+
+# -- admission ---------------------------------------------------------------
+
+def test_paged_admission_bounds_raw_length_not_bucket(bundle):
+    """Dense rejects on the BUCKETED prompt length; paged stores tokens
+    unpadded, so it admits the same request and only rejects what can
+    truly never fit (raw length or whole-pool block need)."""
+    cfg, _, variables = bundle
+    # prompt 9 buckets to 16 under dense: 16 + 20 > 32 rejects
+    dense = _engine(cfg, variables, kv_layout="dense")
+    with pytest.raises(ValueError, match="exceeds cache max_len"):
+        dense.submit(list(range(1, 10)), 20)
+    dense.close()
+    paged = _engine(cfg, variables)
+    fut = paged.submit(list(range(1, 10)), 20)  # 9 + 20 <= 32: fits
+    _drain(paged, [fut])
+    assert len(fut.result(timeout=0)) == 20
+    with pytest.raises(ValueError, match="exceeds cache max_len"):
+        paged.submit(list(range(1, 10)), 30)  # raw 9 + 30 > 32
+    paged.close()
+    tiny_pool = _engine(cfg, variables, kv_blocks=1, kv_block_size=16)
+    with pytest.raises(ValueError, match="can never fit"):
+        tiny_pool.submit([1, 2, 3], 20)  # needs 2 blocks, pool holds 1
+    tiny_pool.close()
+
+
+def test_deferred_admission_preserves_order(bundle):
+    """Pool exhaustion defers (re-queues) instead of erroring, and the
+    deferred request admits BEFORE anything submitted after it."""
+    cfg, model, variables = bundle
+    # pool = 2 blocks of 16: one request's worst case consumes both
+    eng = _engine(cfg, variables, n_slots=2, kv_block_size=16,
+                  kv_blocks=2)
+    fa = eng.submit([5, 3, 9], 14)  # 17 tokens: both pool blocks
+    eng.tick()  # A holds the whole pool
+    fb = eng.submit([1, 4], 4)
+    fc = eng.submit([2, 2], 4)
+    eng.tick()  # B defers (C re-queued behind it, order kept)
+    assert not fb.done() and not fc.done()
+    assert eng._deferrals >= 1
+    assert eng.queue.requeued >= 1
+    while not fa.done():
+        eng.tick()
+    # first post-retirement tick: B must claim the freed blocks first
+    eng.tick()
+    ids = [st.req.request_id
+           for st in list(eng._prefilling.values())] + [
+        fl.req.request_id for fl in list(eng._inflight.values())]
+    assert fb.request_id in ids, "deferred request was not admitted first"
+    _drain(eng, [fb, fc])
+    eng.close()
+    np.testing.assert_array_equal(
+        fb.result(timeout=0), _oracle(model, variables, [1, 4], 4))
+    np.testing.assert_array_equal(
+        fc.result(timeout=0), _oracle(model, variables, [2, 2], 4))
+
+
+def test_healthz_degraded_on_exhaustion_streak(bundle):
+    """An exhaustion streak reads as degraded in healthz_report() —
+    never unhealthy, because it self-recovers as slots retire."""
+    cfg, _, variables = bundle
+    eng = _engine(cfg, variables, n_slots=2, kv_block_size=16,
+                  kv_blocks=2)
+    fa = eng.submit([5, 3, 9], 14)  # 17 tokens: both pool blocks
+    eng.tick()
+    fb = eng.submit([1, 4], 4)
+    eng.tick()  # defer: streak begins
+    assert eng._defer_streak >= 1
+    report = healthz_report()
+    assert report["status"] == "degraded", report
+    mine = [p for p in report["kv_pools"]
+            if p["exhausted_streak"]]
+    assert mine and mine[0]["blocks_total"] == 2
+    _drain(eng, [fa, fb])  # A retires -> B admits -> streak clears
+    assert eng._defer_streak == 0
+    assert healthz_report()["status"] in ("ok", "degraded")
+    assert not [p for p in healthz_report()["kv_pools"]
+                if p["exhausted_streak"]]
+    eng.close()
+
+
+# -- memory + chunk budget ---------------------------------------------------
+
+def test_kv_blocks_scale_with_live_tokens(bundle):
+    """Peak pool usage must track admitted requests' token worst case,
+    not the dense layout's n_slots x max_len contract."""
+    cfg, model, variables = bundle
+    eng = _engine(cfg, variables, n_slots=8, kv_block_size=4)
+    dense_equiv_blocks = 8 * eng._mb  # the dense layout's footprint
+    assert eng._pool.used_count == 0  # no tokens, no blocks
+    futs = [eng.submit([7, 1, 3], 5), eng.submit([2, 9], 4)]
+    eng.tick()
+    # worst case: ceil((3+5)/4) + ceil((2+4)/4) = 2 + 2
+    used_live = eng._pool.used_count
+    assert used_live == 4
+    assert used_live < dense_equiv_blocks / 4
+    _drain(eng, futs)
+    # retired: only the cached prompt prefixes stay resident
+    assert eng._pool.used_count == eng._prefix.cached_blocks
+    assert eng._pool.used_count <= 2
+    eng.close()
+
+
+def test_long_prompt_admit_never_stalls_decode_beyond_chunk(bundle):
+    """Chunked prefill: while a long prompt admits, every tick still
+    advances the in-flight decode, and no tick prefills more than the
+    chunk budget."""
+    cfg, model, variables = bundle
+    chunk = 4
+    eng = _engine(cfg, variables, prefill_chunk=chunk, kv_block_size=4)
+    short = eng.submit([6, 8], 12)
+    eng.tick()
+    produced_before = len(next(iter(eng._inflight.values())).produced)
+    long_prompt = list(np.random.default_rng(0).integers(1, 64, 17))
+    longf = eng.submit(long_prompt, 3)
+    eng.tick()  # admits the long prompt: first chunk only
+    assert eng._prefilling, "17-token prompt should span several chunks"
+    ticks_to_admit = 1
+    while eng._prefilling:
+        before = len(next(iter(eng._inflight.values())).produced)
+        eng.tick()
+        ticks_to_admit += 1
+        if short.done():
+            break
+        after = len(next(iter(eng._inflight.values())).produced)
+        assert after > before, "decode stalled during long-prompt admit"
+    assert ticks_to_admit >= 2  # 17 tokens / chunk 4: several ticks
+    assert eng._max_tick_prefill_tokens <= chunk
+    _drain(eng, [short, longf])
+    eng.close()
+    np.testing.assert_array_equal(
+        short.result(timeout=0), _oracle(model, variables, [6, 8], 12))
+    np.testing.assert_array_equal(
+        longf.result(timeout=0),
+        _oracle(model, variables, long_prompt, 3))
+    del produced_before
+
+
+@pytest.mark.slow
+def test_soak_mixed_long_short_chunk_budget(bundle):
+    """Threaded soak, mixed long/short prompts under a small chunk:
+    every output oracle-identical, prefix cache exercised, and no tick
+    ever prefilled past the chunk budget."""
+    cfg, model, variables = bundle
+    rng = np.random.default_rng(1)
+    chunk = 4
+    eng = ContinuousGPTEngine(
+        cfg, variables, n_slots=4, max_len=MAX_LEN, idle_wait_s=0.001,
+        prefill_chunk=chunk, kv_block_size=4,
+    )
+    shared = rng.integers(1, cfg.vocab_size, 8).tolist()
+    cases, futs = [], []
+    for i in range(20):
+        if i % 3 == 0:  # long, shared prefix
+            prompt = shared + rng.integers(1, cfg.vocab_size,
+                                           int(rng.integers(4, 12))).tolist()
+        else:  # short
+            prompt = rng.integers(1, cfg.vocab_size,
+                                  int(rng.integers(1, 6))).tolist()
+        max_new = int(rng.integers(1, 8))
+        cases.append((prompt, max_new))
+        futs.append(eng.submit(prompt, max_new))
+        time.sleep(float(rng.uniform(0, 0.008)))
+    eng.close(drain=True)
+    for (prompt, max_new), fut in zip(cases, futs):
+        np.testing.assert_array_equal(
+            fut.result(timeout=0),
+            _oracle(model, variables, prompt, max_new),
+            err_msg=f"prompt {prompt} x{max_new}",
+        )
+    assert eng._max_tick_prefill_tokens <= chunk
+    assert eng._prefix.hit_tokens > 0  # the shared prefix got reused
+    assert eng.snapshot()["completed"] == 20
