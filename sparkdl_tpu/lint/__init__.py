@@ -1,0 +1,49 @@
+"""sparkdl-lint: AST-based invariant checker for this codebase (ISSUE 11).
+
+Five subsystems now rest on conventions no compiler enforces: lock-guarded
+mutable state in the serving/reliability threads, donated JAX buffers that
+must never be read after dispatch, the ``sparkdl_*`` metric families, the
+``fault_point`` site names, and the ``resolve_pin`` env-var contract. This
+package machine-checks them — the graph-layer validation discipline of the
+TensorFlow/tf.data systems papers (PAPERS.md), applied to the host-side
+Python that orchestrates the chips — so later PRs can refactor freely
+without re-deriving the invariants by review.
+
+Zero-dependency by construction: stdlib ``ast`` + ``re`` only, importable
+before jax exists (conftest and run-tests.sh run it as a tier-1 gate).
+
+Usage::
+
+    python -m sparkdl_tpu.lint sparkdl_tpu/ tests/           # human output
+    python -m sparkdl_tpu.lint --format json sparkdl_tpu/    # machine output
+    python -m sparkdl_tpu.lint --list-rules
+
+Exit codes: 0 = clean, 1 = findings, 2 = usage/internal error.
+
+Suppressions are line-scoped comments with REQUIRED justification text::
+
+    x = 1  # sparkdl-lint: disable=lock-discipline -- published before start()
+
+(on the flagged line, or alone on the line above it). A suppression
+without ``-- <why>`` is itself a finding. See README "Static analysis".
+"""
+
+from sparkdl_tpu.lint.core import (
+    Finding,
+    LintReport,
+    Project,
+    Rule,
+    SourceFile,
+    lint_paths,
+)
+from sparkdl_tpu.lint.rules import ALL_RULES
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "LintReport",
+    "Project",
+    "Rule",
+    "SourceFile",
+    "lint_paths",
+]

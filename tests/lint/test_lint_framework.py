@@ -1,0 +1,358 @@
+"""Framework contracts: suppression grammar (justification REQUIRED),
+exit codes, the golden JSON report shape, and the seeded-violation demo
+run-tests.sh's gate relies on (a planted bad file must fail the CLI)."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from sparkdl_tpu.lint.core import SourceFile, lint_paths
+
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "lint_fixtures")
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# suppression grammar
+# ---------------------------------------------------------------------------
+
+
+class TestSuppressions:
+    def test_trailing_with_justification(self):
+        src = SourceFile("x.py", "a = 1  # sparkdl-lint: "
+                         "disable=lock-discipline -- init publication\n")
+        hit, why = src.suppression_for("lock-discipline", 1)
+        assert hit and why == "init publication"
+        assert not src.suppression_for("env-pin", 1)[0]
+        assert src.bad_suppressions == []
+
+    def test_standalone_comment_covers_next_line(self):
+        src = SourceFile("x.py", (
+            "# sparkdl-lint: disable=env-pin -- bootstrap read\n"
+            "import os\n"))
+        assert src.suppression_for("env-pin", 2)[0]
+        assert not src.suppression_for("env-pin", 3)[0]
+
+    def test_multiple_rules_one_comment(self):
+        src = SourceFile("x.py", "a = 1  # sparkdl-lint: "
+                         "disable=env-pin,metric-drift -- shared reason\n")
+        assert src.suppression_for("env-pin", 1)[0]
+        assert src.suppression_for("metric-drift", 1)[0]
+
+    def test_covers_whole_multiline_simple_statement(self):
+        """A finding may anchor to a continuation line of a wrapped
+        statement; a suppression above (or trailing) the statement's
+        first line covers every line of it."""
+        src = SourceFile("x.py", (
+            "# sparkdl-lint: disable=blocking-in-hot-loop -- resolved\n"
+            "outs = consume(\n"
+            "    fut.result())\n"))
+        assert src.suppression_for("blocking-in-hot-loop", 2)[0]
+        assert src.suppression_for("blocking-in-hot-loop", 3)[0]
+        assert not src.suppression_for("blocking-in-hot-loop", 4)[0]
+
+    def test_compound_statement_is_not_blanket_covered(self):
+        src = SourceFile("x.py", (
+            "# sparkdl-lint: disable=sleep-poll -- loop head only\n"
+            "while waiting():\n"
+            "    time.sleep(1)\n"))
+        assert src.suppression_for("sleep-poll", 2)[0]
+        # the loop BODY is not blanketed by a comment above the loop
+        assert not src.suppression_for("sleep-poll", 3)[0]
+
+    def test_suppression_text_inside_strings_is_ignored(self):
+        """'# sparkdl-lint: ...' examples in docstrings/log strings are
+        neither suppressions nor missing-justification findings — only
+        REAL comment tokens carry the grammar."""
+        src = SourceFile("x.py", (
+            '"""Docs: write `# sparkdl-lint: disable=env-pin` plus a\n'
+            "justification to silence a finding.\"\"\"\n"
+            "msg = 'try # sparkdl-lint: disable=lock-discipline'\n"))
+        assert src.suppressions == {}
+        assert src.bad_suppressions == []
+
+    def test_missing_justification_is_recorded(self):
+        src = SourceFile(
+            "x.py", "a = 1  # sparkdl-lint: disable=env-pin\n")
+        assert src.bad_suppressions == [(1, "env-pin")]
+
+    def test_missing_justification_is_an_active_finding(self, tmp_path):
+        bad = tmp_path / "pkg" / "mod.py"
+        bad.parent.mkdir()
+        bad.write_text(
+            "import os\n"
+            "x = os.environ.get('SPARKDL_TPU_NEW_THING')"
+            "  # sparkdl-lint: disable=env-pin\n")
+        report = lint_paths([str(tmp_path / "pkg")], root=str(tmp_path))
+        rules = {f.rule for f in report.findings}
+        assert "suppression-missing-justification" in rules
+        # the unjustified suppression still suppresses nothing is NOT the
+        # contract — it suppresses, but the justification finding keeps
+        # the run red, so it can never land silently
+        assert report.exit_code == 1
+
+    def test_justified_suppression_moves_finding_to_suppressed(
+            self, tmp_path):
+        bad = tmp_path / "pkg" / "mod.py"
+        bad.parent.mkdir()
+        bad.write_text(
+            "import os\n"
+            "x = os.environ.get('SPARKDL_TPU_NEW_THING')"
+            "  # sparkdl-lint: disable=env-pin -- migration shim\n")
+        report = lint_paths([str(tmp_path / "pkg")], root=str(tmp_path))
+        assert report.exit_code == 0
+        assert len(report.suppressed) == 1
+        assert report.suppressed[0].justification == "migration shim"
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes + JSON golden
+# ---------------------------------------------------------------------------
+
+
+def _seed_project(tmp_path, *fixture_names, tests=()):
+    """Copy fixtures into a throwaway project layout (pkg/ + tests/)."""
+    pkg = tmp_path / "pkg"
+    t = tmp_path / "tests"
+    pkg.mkdir()
+    t.mkdir()
+    (tmp_path / "README.md").write_text("# demo\n")
+    for name in fixture_names:
+        shutil.copy(os.path.join(FIXTURES, name), pkg / name)
+    for name in tests:
+        shutil.copy(os.path.join(FIXTURES, name), t / name)
+    return tmp_path
+
+
+def _run_cli(*args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.lint", *args],
+        capture_output=True, text=True, cwd=cwd, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+class TestCLI:
+    def test_clean_tree_exits_zero(self, tmp_path):
+        proj = _seed_project(tmp_path, "lock_ok.py", "donation_ok.py",
+                             "hotloop_ok.py", "env_ok.py")
+        p = _run_cli("pkg", "--root", ".", cwd=proj)
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "0 finding(s)" in p.stdout
+
+    def test_seeded_violation_fails_the_run(self, tmp_path):
+        """The run-tests.sh gate demo: introduce one bad file and the
+        lint stage exits 1, naming the file, rule, and line."""
+        proj = _seed_project(tmp_path, "lock_ok.py")
+        p = _run_cli("pkg", "--root", ".", cwd=proj)
+        assert p.returncode == 0
+        shutil.copy(os.path.join(FIXTURES, "lock_bad.py"),
+                    proj / "pkg" / "lock_bad.py")
+        p = _run_cli("pkg", "--root", ".", cwd=proj)
+        assert p.returncode == 1
+        line = [ln for ln in p.stdout.splitlines()
+                if "lock-discipline" in ln]
+        assert line and "pkg/lock_bad.py:16" in line[0]
+
+    def test_unknown_rule_is_usage_error(self, tmp_path):
+        p = _run_cli("--rule", "no-such-rule", ".", cwd=tmp_path)
+        assert p.returncode == 2
+        assert "unknown rule" in p.stderr
+
+    def test_list_rules(self, tmp_path):
+        p = _run_cli("--list-rules", cwd=tmp_path)
+        assert p.returncode == 0
+        for rule in ("lock-discipline", "donation-safety", "env-pin",
+                     "metric-drift", "fault-coverage",
+                     "blocking-in-hot-loop", "sleep-poll"):
+            assert rule in p.stdout
+
+    def test_golden_json_report(self, tmp_path):
+        """The machine-readable contract run-tests.sh prints the path
+        to: schema version, counts, findings with (rule, path, line,
+        message), suppressed findings carrying their justification."""
+        proj = _seed_project(tmp_path, "lock_bad.py")
+        (proj / "pkg" / "suppressed.py").write_text(
+            "import os\n"
+            "x = os.environ.get('SPARKDL_TPU_GOLDEN')"
+            "  # sparkdl-lint: disable=env-pin -- golden fixture\n")
+        p = _run_cli("pkg", "--root", ".", "--format", "json",
+                     "--output", "report.json", cwd=proj)
+        assert p.returncode == 1
+        doc = json.loads(p.stdout)
+        on_disk = json.loads((proj / "report.json").read_text())
+        doc.pop("elapsed_s")
+        on_disk.pop("elapsed_s")
+        golden = {
+            "version": 1,
+            "files_scanned": 2,
+            "rules": [
+                "lock-discipline", "donation-safety",
+                "blocking-in-hot-loop", "metric-drift",
+                "fault-coverage", "env-pin", "sleep-poll",
+            ],
+            "findings_total": 1,
+            "suppressed_total": 1,
+            "findings": [{
+                "rule": "lock-discipline",
+                "path": "pkg/lock_bad.py",
+                "line": 16,
+                "message": (
+                    "Engine.reset assigns 'self.depth' outside 'with "
+                    "self._lock' but other code paths assign it under "
+                    "that lock — hold the lock, or suppress with the "
+                    "reason it is safe here"),
+            }],
+            "suppressed": [{
+                "rule": "env-pin",
+                "path": "pkg/suppressed.py",
+                "line": 2,
+                "message": (
+                    "direct read of SPARKDL_TPU_GOLDEN outside "
+                    "resolve_pin and the documented allowlist — give "
+                    "the knob a resolve_pin contract, or add it to "
+                    "lint.rules.ENV_ALLOWLIST with its reason (README: "
+                    "Static analysis)"),
+                "suppressed": True,
+                "justification": "golden fixture",
+            }],
+        }
+        assert doc == golden
+        assert doc == on_disk
+
+
+# ---------------------------------------------------------------------------
+# walker details
+# ---------------------------------------------------------------------------
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    bad = tmp_path / "pkg" / "broken.py"
+    bad.parent.mkdir()
+    bad.write_text("def f(:\n")
+    report = lint_paths([str(tmp_path / "pkg")], root=str(tmp_path))
+    assert report.exit_code == 1
+    assert report.findings[0].rule == "parse-error"
+
+
+def test_lint_fixtures_dir_is_excluded_from_walks(tmp_path):
+    """The deliberate-violation corpus must never fail a default walk."""
+    pkg = tmp_path / "pkg"
+    (pkg / "lint_fixtures").mkdir(parents=True)
+    shutil.copy(os.path.join(FIXTURES, "lock_bad.py"),
+                pkg / "lint_fixtures" / "lock_bad.py")
+    report = lint_paths([str(pkg)], root=str(tmp_path))
+    assert report.files_scanned == 0
+    assert report.exit_code == 0
+
+
+def test_aux_run_tests_sh_is_auto_discovered(tmp_path):
+    """A fault plan that exists only in run-tests.sh still counts as
+    exercising its site (and its ghost sites are still findings)."""
+    pkg = tmp_path / "pkg"
+    t = tmp_path / "tests"
+    pkg.mkdir()
+    t.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "fault_bad.py"),
+                pkg / "fault_bad.py")
+    (t / "test_dummy.py").write_text("def test_pass():\n    pass\n")
+    (tmp_path / "README.md").write_text("# demo\n")
+    (tmp_path / "run-tests.sh").write_text(
+        'SPARKDL_TPU_FAULT_PLAN="fixture.orphan:RuntimeError@3" '
+        "python -c pass\n")
+    # without the aux plan the site is orphaned (proves the coverage
+    # check is actually active on this scope, not skipped)
+    bare = lint_paths([str(pkg), str(t)], root=str(tmp_path / "pkg"))
+    assert any("fixture.orphan" in f.message for f in bare.findings)
+    report = lint_paths([str(pkg), str(t)], root=str(tmp_path))
+    assert report.exit_code == 0, [f.render() for f in report.findings]
+
+
+def test_partial_scans_skip_cross_set_coverage_checks(tmp_path):
+    """The documented package-only invocation must not report false
+    'unexercised site' drift (test plans are simply out of scope), and a
+    tests-only scan must not report ghost sites (production
+    fault_points are out of scope)."""
+    pkg = tmp_path / "pkg"
+    t = tmp_path / "tests"
+    pkg.mkdir()
+    t.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "fault_bad.py"),
+                pkg / "fault_bad.py")
+    shutil.copy(os.path.join(FIXTURES, "fault_plans_testfile.py"),
+                t / "fault_plans_testfile.py")
+    (tmp_path / "README.md").write_text("# demo\n")
+    pkg_only = lint_paths([str(pkg)], root=str(tmp_path))
+    assert pkg_only.exit_code == 0, [
+        f.render() for f in pkg_only.findings]
+    tests_only = lint_paths([str(t)], root=str(tmp_path))
+    assert tests_only.exit_code == 0, [
+        f.render() for f in tests_only.findings]
+
+
+def _load_root_conftest():
+    import importlib.util
+    import sys
+
+    mod = sys.modules.get("conftest")
+    if mod is not None and hasattr(mod, "fail_on_sleep_polls"):
+        return mod
+    path = os.path.join(REPO, "tests", "conftest.py")
+    spec = importlib.util.spec_from_file_location("_root_conftest", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestSleepPollGuard:
+    def test_unbounded_poll_fails_collection_guard(self, tmp_path):
+        conftest = _load_root_conftest()
+        (tmp_path / "test_poll.py").write_text(
+            "import time\n"
+            "def test_x():\n"
+            "    while not done():\n"
+            "        time.sleep(0.01)\n")
+        with pytest.raises(Exception, match="test_poll.py:4"):
+            conftest.fail_on_sleep_polls(str(tmp_path))
+
+    def test_unjustified_suppression_does_not_silence_guard(
+            self, tmp_path):
+        conftest = _load_root_conftest()
+        (tmp_path / "test_poll.py").write_text(
+            "import time\n"
+            "def test_x():\n"
+            "    while not done():\n"
+            "        # sparkdl-lint: disable=sleep-poll\n"
+            "        time.sleep(0.01)\n")
+        with pytest.raises(Exception, match="lacks"):
+            conftest.fail_on_sleep_polls(str(tmp_path))
+
+    def test_justified_suppression_passes_guard(self, tmp_path):
+        conftest = _load_root_conftest()
+        (tmp_path / "test_poll.py").write_text(
+            "import time\n"
+            "def test_x():\n"
+            "    while not done():\n"
+            "        # sparkdl-lint: disable=sleep-poll -- demo reason\n"
+            "        time.sleep(0.01)\n")
+        conftest.fail_on_sleep_polls(str(tmp_path))  # no raise
+
+
+@pytest.mark.parametrize("fixture,expected_rule", [
+    ("lock_bad.py", "lock-discipline"),
+    ("donation_bad.py", "donation-safety"),
+    ("hotloop_bad.py", "blocking-in-hot-loop"),
+    ("env_bad.py", "env-pin"),
+])
+def test_positive_fixtures_fail_via_api(tmp_path, fixture, expected_rule):
+    proj = _seed_project(tmp_path, fixture)
+    report = lint_paths([str(proj / "pkg")], root=str(proj))
+    assert report.exit_code == 1
+    assert expected_rule in {f.rule for f in report.findings}

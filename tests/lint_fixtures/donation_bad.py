@@ -1,0 +1,35 @@
+"""Fixture: donation-safety POSITIVE — donated buffers read after call."""
+
+import functools
+
+import jax
+
+from sparkdl_tpu.runtime.dispatch import chain_carry
+
+
+def train(step_fn, state, xs):
+    chained = chain_carry(step_fn, donate=True)
+    new_state, outs = chained(state, xs)
+    print(state)  # VIOLATION: donated `state` read before rebinding
+    return new_state, outs
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _step(params, cache, tok):
+    return tok, cache
+
+
+class Engine:
+    def __init__(self):
+        self._step_fn = _step
+
+    def decode(self, params, tok):
+        toks, cache2 = self._step_fn(params, self._cache, tok)
+        return toks, self._cache  # VIOLATION: self._cache is dead
+
+
+def loop_body(step_fn, state, xs):
+    chained = chain_carry(step_fn)
+    for x in xs:
+        _ignored, out = chained(state, x)  # VIOLATION: state never
+        yield out                          # rebound inside the loop

@@ -1,0 +1,35 @@
+"""Fixture: donation-safety NEGATIVE — the rebind idioms."""
+
+import functools
+
+import jax
+
+from sparkdl_tpu.runtime.dispatch import chain_carry
+
+
+def train(step_fn, state, xs):
+    chained = chain_carry(step_fn, donate=True)
+    state, outs = chained(state, xs)  # consumed AND rebound: safe
+    return state, outs
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _step(params, cache, tok):
+    return tok, cache
+
+
+class Engine:
+    def decode(self, params, tok):
+        toks, self._cache = self._step_fn(params, self._cache, tok)
+        return toks, self._cache  # rebound by the call statement: safe
+
+    def loop(self, params, toks):
+        for tok in toks:
+            out, self._cache = self._step_fn(params, self._cache, tok)
+            yield out
+
+
+def undonated(step_fn, state, xs):
+    chained = chain_carry(step_fn, donate=False)
+    new_state, outs = chained(state, xs)
+    return state, new_state, outs  # donate=False: reading state is fine

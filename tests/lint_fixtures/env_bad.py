@@ -1,0 +1,12 @@
+"""Fixture: env-pin POSITIVE — direct reads of pin-managed and
+unlisted SPARKDL_TPU_* variables."""
+
+import os
+
+_CHUNK = os.environ.get("SPARKDL_TPU_PREFILL_CHUNK")  # VIOLATION: pin-managed
+
+_NEW_KNOB = "SPARKDL_TPU_MADE_UP_KNOB"
+
+
+def read_knob():
+    return os.getenv(_NEW_KNOB)  # VIOLATION: not on the allowlist

@@ -1,0 +1,16 @@
+"""Fixture: env-pin NEGATIVE — resolver-internal and allowlisted reads."""
+
+import os
+
+
+def resolve_pin(explicit, env_var, default, *, what):
+    raw = os.environ.get(env_var)  # the resolver owns the contract
+    return int(raw) if raw else default
+
+
+def tracing_enabled():
+    return bool(os.environ.get("SPARKDL_TPU_TRACE"))  # allowlisted
+
+
+def unrelated():
+    return os.environ.get("HOME")  # not a SPARKDL_TPU_* var

@@ -1,0 +1,7 @@
+"""Fixture: fault-coverage POSITIVE — an unexercised production site."""
+
+from sparkdl_tpu.reliability.faults import fault_point
+
+
+def hot_path():
+    fault_point("fixture.orphan")  # no plan anywhere names this site

@@ -1,0 +1,8 @@
+"""Fixture: fault-coverage NEGATIVE — site covered by the test plan in
+test_fault_plans.py."""
+
+from sparkdl_tpu.reliability.faults import fault_point
+
+
+def hot_path():
+    fault_point("fixture.covered")

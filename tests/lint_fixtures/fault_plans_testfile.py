@@ -1,0 +1,11 @@
+"""Fixture (linted under a tests/ rel path, so classified as a test
+file): plans exercising one real site and naming one ghost site."""
+
+from sparkdl_tpu.reliability.faults import inject
+
+
+def test_plan():
+    with inject("fixture.covered:RuntimeError@1"):
+        pass
+    with inject("fixture.ghost@2"):  # names a site that does not exist
+        pass

@@ -1,0 +1,23 @@
+"""Fixture: blocking-in-hot-loop POSITIVE — sleeps and unbounded waits
+inside the loop, including through a same-class helper."""
+
+import time
+
+
+class Batcher:
+    def _loop(self):
+        while not self._stop.is_set():
+            time.sleep(0.01)  # VIOLATION: sleeping engine thread
+            self._resolve()
+
+    def _resolve(self):
+        out = self._pending.result()  # VIOLATION: un-timed-out wait
+        self._worker.join()  # VIOLATION: un-timed-out join
+        return out
+
+
+class Engine:
+    def tick(self):
+        import jax
+
+        return jax.device_get(self._state)  # VIOLATION: sync D2H

@@ -1,0 +1,16 @@
+"""Fixture: blocking-in-hot-loop NEGATIVE — timed waits in the loop,
+blocking calls outside hot methods."""
+
+import time
+
+
+class Batcher:
+    def _loop(self):
+        while not self._stop.wait(0.01):  # timed: fine
+            out = self._pending.result(timeout=5.0)
+            self._consume(out)
+
+    def shutdown(self):
+        # not a hot method: unbounded join is the caller's choice
+        self._worker.join()
+        time.sleep(0.05)

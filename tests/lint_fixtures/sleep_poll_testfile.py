@@ -1,0 +1,20 @@
+"""Fixture (test-classified): sleep-poll positive, negative, suppressed."""
+
+import time
+
+
+def test_bad_poll():
+    while not done():
+        time.sleep(0.01)  # VIOLATION: no deadline in the condition
+
+
+def test_deadlined_poll():
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        time.sleep(0.01)
+
+
+def test_suppressed_poll():
+    while not done():
+        # sparkdl-lint: disable=sleep-poll -- fixture demonstrating a justified suppression
+        time.sleep(0.01)
