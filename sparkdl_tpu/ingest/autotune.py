@@ -389,14 +389,22 @@ class AutoTuner:
         with self._lock:
             if self._thread is not None and self._thread.is_alive():
                 return self
-            self._stop.clear()
+            # each cadence thread owns a FRESH stop event (captured by
+            # argument, never re-read): a start() racing a stop() can
+            # therefore never resurrect the old thread — the old event
+            # stays set and that thread exits at its next wake, while
+            # the new thread waits on the new event (sparkdl-lint
+            # lock-discipline follow-up: re-using one cleared event
+            # here used to leave TWO live tick loops)
+            stop = self._stop = threading.Event()
             self._thread = threading.Thread(
-                target=self._loop, name="sparkdl-autotune", daemon=True
+                target=self._loop, args=(stop,),
+                name="sparkdl-autotune", daemon=True,
             )
             self._thread.start()
         return self
 
-    def _loop(self) -> None:
+    def _loop(self, stop: threading.Event) -> None:
         import logging
 
         log = logging.getLogger(__name__)
@@ -405,7 +413,7 @@ class AutoTuner:
             "autotuner samples that raised (knob raced its stream "
             "closing, or a broken signal reader)")
         logged = False
-        while not self._stop.wait(self.interval_s):
+        while not stop.wait(self.interval_s):
             try:
                 self.tick()
             except Exception:
@@ -422,11 +430,20 @@ class AutoTuner:
                 continue
 
     def stop(self) -> None:
-        self._stop.set()
-        t = self._thread
+        # the stop signal AND the thread-handle claim happen under the
+        # same lock start() uses (sparkdl-lint lock-discipline): a stop
+        # racing a start can no longer clobber the fresh handle with
+        # None, and since every thread owns its event (start swaps in a
+        # fresh one under this lock), setting the current event can
+        # only ever stop the current thread. The join stays OUTSIDE the
+        # lock — tick() takes it, so joining while holding it would
+        # deadlock.
+        with self._lock:
+            self._stop.set()
+            t = self._thread
+            self._thread = None
         if t is not None and t.is_alive():
             t.join(timeout=2.0)
-        self._thread = None
 
     def __enter__(self) -> "AutoTuner":
         return self.start()
