@@ -72,6 +72,7 @@ KNOWN_SITES = (
     "fetch",
     "replica.execute",
     "checkpoint.save",
+    "kv.alloc",
     "worker.rank",
 )
 

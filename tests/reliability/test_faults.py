@@ -1,6 +1,10 @@
 """FaultPlan/fault_point: parse syntax, deterministic triggers, metrics,
 and the zero-cost-when-disarmed contract the hot paths rely on."""
 
+import os
+import subprocess
+import sys
+import textwrap
 import time
 
 import pytest
@@ -160,6 +164,36 @@ class TestArming:
         assert p is not None and p.rules[0].site == "worker.rank"
         monkeypatch.setenv(faults.ENV_VAR, "")
         assert FaultPlan.from_env() is None
+
+
+def test_rank_targeted_env_plan_kills_only_that_rank():
+    """The ``_worker.py`` contract end to end: a rank-suffixed plan
+    (``worker.rank.1``) inherited through the environment fires in the
+    child whose rank is 1 and in no other — each child parses
+    ``SPARKDL_TPU_FAULT_PLAN`` once at import with no plumbing. This is
+    the test-plan coverage for the ``worker.rank.*`` fault site
+    (sparkdl-lint fault-coverage)."""
+    code = textwrap.dedent("""
+        import sys
+        from sparkdl_tpu.reliability.faults import fault_point
+        rank = int(sys.argv[1])
+        # the exact pair of sites runner/_worker.py arms per rank
+        fault_point("worker.rank")
+        fault_point(f"worker.rank.{rank}")
+        print("survived", rank)
+    """)
+    env = {**os.environ,
+           "SPARKDL_TPU_FAULT_PLAN": "worker.rank.1:RuntimeError@1",
+           "JAX_PLATFORMS": "cpu"}
+    results = {}
+    for rank in (0, 1):
+        results[rank] = subprocess.run(
+            [sys.executable, "-c", code, str(rank)], env=env,
+            capture_output=True, text=True, timeout=120)
+    assert results[0].returncode == 0, results[0].stderr
+    assert "survived 0" in results[0].stdout
+    assert results[1].returncode != 0
+    assert "worker.rank.1" in results[1].stderr
 
 
 def test_disarmed_fault_point_is_nearly_free():
