@@ -230,6 +230,7 @@ class MicroBatcher:
             live, feeds, fut, ctx = self._pending.popleft()
             with tracing.attach(ctx):
                 try:
+                    # sparkdl-lint: disable=blocking-in-hot-loop -- resolution is guaranteed: BatchResult resolves with its dispatch, _Work by the pool's first-writer-wins/_fail_inflight invariants (PR 5); a timeout here would fail healthy slow batches
                     outs = fut.result()
                 except Exception as e:
                     self._complete_failed(live, feeds, e)
