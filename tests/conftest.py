@@ -25,8 +25,83 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# tests/lint_fixtures/ holds DELIBERATE rule violations for the linter's
+# own suite: never collected, never scanned by the guards below (the
+# linter's default walk skips the directory too — lint.core.EXCLUDED_DIRS)
+collect_ignore = ["lint_fixtures"]
+
+import time  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def wait_until(predicate, *, timeout_s=10.0, interval_s=0.01,
+               desc="condition"):
+    """Deadline-bounded polling: the ONE sanctioned way to wait for an
+    asynchronous condition in tests. Returns the first truthy value of
+    ``predicate()``; raises AssertionError naming ``desc`` at
+    ``timeout_s`` — a stuck predicate fails the test instead of hanging
+    the suite (the flaky-soak trap sparkdl-lint's ``sleep-poll`` rule
+    and the collection guard below reject)."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        value = predicate()
+        if value:
+            return value
+        if time.monotonic() >= deadline:
+            raise AssertionError(
+                f"timed out after {timeout_s}s waiting for {desc}")
+        # sparkdl-lint: disable=sleep-poll -- this IS the deadline helper; the bound is enforced two lines above the sleep
+        time.sleep(interval_s)
+
+
+@pytest.fixture(name="wait_until", scope="session")
+def wait_until_fixture():
+    return wait_until
+
+
+def fail_on_sleep_polls(root):
+    """Collection-time twin of the basename guard below: a test file
+    with a ``while`` loop that ``time.sleep``-polls WITHOUT a deadline
+    in its condition hangs the whole suite when the predicate wedges.
+    Fail the run loudly at conftest import, pointing at the loop — use
+    the ``wait_until`` fixture (or bound the loop on time.monotonic()).
+    Suppressible per line with justification:
+    ``# sparkdl-lint: disable=sleep-poll -- <why>``."""
+    import pathlib
+
+    from sparkdl_tpu.lint.core import SourceFile
+    from sparkdl_tpu.lint.rules import scan_sleep_polls
+
+    bad = []
+    for path in sorted(pathlib.Path(root).rglob("test_*.py")):
+        if "lint_fixtures" in path.parts:
+            continue
+        text = path.read_text()
+        if "time.sleep" not in text and "sleep(" not in text:
+            continue  # cheap pre-filter: no parse for sleep-free files
+        src = SourceFile(str(path), text,
+                         rel=str(path.relative_to(root)))
+        if src.tree is None:
+            continue  # pytest will surface the syntax error itself
+        for finding in scan_sleep_polls(src.tree, src.rel):
+            hit, why = src.suppression_for("sleep-poll", finding.line)
+            if hit and why:
+                continue
+            if hit:  # suppressed WITHOUT the required justification
+                bad.append(f"{finding.path}:{finding.line} "
+                           "(suppression lacks '-- <why>' justification)")
+            else:
+                bad.append(f"{finding.path}:{finding.line}")
+    if bad:
+        raise pytest.UsageError(
+            "time.sleep polling loop(s) with no deadline in the loop "
+            "condition (a stuck predicate hangs the suite): "
+            + ", ".join(bad)
+            + " — use the wait_until fixture from conftest, or bound "
+            "the loop on time.monotonic()"
+        )
 
 
 def fail_on_duplicate_test_basenames(root):
@@ -39,6 +114,8 @@ def fail_on_duplicate_test_basenames(root):
 
     seen: "dict[str, list]" = {}
     for path in sorted(pathlib.Path(root).rglob("test_*.py")):
+        if "lint_fixtures" in path.parts:
+            continue
         seen.setdefault(path.name, []).append(path)
     dups = {name: paths for name, paths in seen.items() if len(paths) > 1}
     if dups:
@@ -55,6 +132,7 @@ def fail_on_duplicate_test_basenames(root):
 
 
 fail_on_duplicate_test_basenames(os.path.dirname(os.path.abspath(__file__)))
+fail_on_sleep_polls(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture(scope="session")

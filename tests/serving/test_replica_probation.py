@@ -219,7 +219,7 @@ def test_probation_none_is_permanent_quarantine():
         pool.close()
 
 
-def test_hung_dispatch_watchdog_fails_work_and_pool_survives():
+def test_hung_dispatch_watchdog_fails_work_and_pool_survives(wait_until):
     made = []
 
     def make_runner(device):
@@ -252,12 +252,8 @@ def test_hung_dispatch_watchdog_fails_work_and_pool_survives():
             single.run_batch(_batch(4, seed=1)))
         # the wedged program completes eventually and the replica rejoins
         # through the normal success path
-        deadline = time.monotonic() + 10.0
-        while time.monotonic() < deadline:
-            if pool.snapshot()["healthy_count"] == 2:
-                break
-            time.sleep(0.05)
-        assert pool.snapshot()["healthy_count"] == 2
+        wait_until(lambda: pool.snapshot()["healthy_count"] == 2,
+                   interval_s=0.05, desc="wedged replica rejoined")
         np.testing.assert_array_equal(
             pool.run_batch(_batch(3, seed=2)),
             single.run_batch(_batch(3, seed=2)))
@@ -318,7 +314,7 @@ def test_hung_replica_rejoins_when_wedged_dispatch_errors():
         pool.close()
 
 
-def test_hung_dispatch_rerouted_rider_gets_result():
+def test_hung_dispatch_rerouted_rider_gets_result(wait_until):
     """A reroutable batch whose dispatch wedges is re-routed by the
     watchdog — the rider gets a RESULT from a healthy replica, not a
     HungDispatchError (same protection as an executor error)."""
@@ -364,11 +360,8 @@ def test_hung_dispatch_rerouted_rider_gets_result():
         # the wedged dispatch eventually SUCCEEDS (late): it heals the
         # replica but must NOT double-count the rerouted batch's
         # recovery — only the claimant records the outcome
-        deadline = time.monotonic() + 10.0
-        while (pool.snapshot()["replicas"][0]["hung"]
-               and time.monotonic() < deadline):
-            time.sleep(0.05)
-        assert not pool.snapshot()["replicas"][0]["hung"]
+        wait_until(lambda: not pool.snapshot()["replicas"][0]["hung"],
+                   interval_s=0.05, desc="hung-freeze lifted")
         assert _counter("sparkdl_retries_total", site="replica.execute",
                         outcome="recovered") == recovered0 + 1
     finally:
