@@ -165,6 +165,21 @@ def main() -> None:
     # amortization, not just img/s.
     gap = calibrate_dispatch_gap()
     n_dispatches = dispatch_count("bench") - d_before
+    # Static-analysis drift tracker (ISSUE 11): the artifact embeds the
+    # linter's finding count over the package, so a rule regression shows
+    # up in the bench trajectory like any perf regression (run after
+    # timing; ~1-2s of host work, PERF.md "sparkdl-lint wall time").
+    import sparkdl_tpu
+    from sparkdl_tpu.lint import lint_paths
+
+    pkg_dir = os.path.dirname(os.path.abspath(sparkdl_tpu.__file__))
+    repo_root = os.path.dirname(pkg_dir)
+    lint_targets = [pkg_dir] + [
+        p for p in (os.path.join(repo_root, "tests"),)
+        if os.path.isdir(p)  # fault plans live in the test tree
+    ]
+    lint_findings_total = len(
+        lint_paths(lint_targets, root=repo_root).findings)
     # dp>1 reports AGGREGATE throughput; vs_baseline stays per-chip so the
     # number remains comparable to the single-chip target.
     print(
@@ -184,6 +199,7 @@ def main() -> None:
                 "overhead_share": round(
                     overhead_share(n_dispatches, dt, gap) or 0.0, 4
                 ),
+                "lint_findings_total": lint_findings_total,
                 "observability": registry().snapshot(),
             }
         )

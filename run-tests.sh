@@ -6,6 +6,24 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+# Tier-1 gate 0 (ISSUE 11): sparkdl-lint — AST invariant checks for
+# concurrency (lock discipline), donated-buffer safety, hot-loop
+# blocking, metric-family drift, fault-site coverage, and the env-pin
+# contract. Fails the whole run on any finding; the JSON report (incl.
+# every suppression + its justification) is printed for triage.
+# `./run-tests.sh --lint-only` is the fast pre-commit path.
+LINT_REPORT="${LINT_REPORT:-/tmp/sparkdl-lint.json}"
+if JAX_PLATFORMS=cpu python -m sparkdl_tpu.lint sparkdl_tpu/ tests/ \
+    --output "$LINT_REPORT"; then
+  echo "sparkdl-lint OK (report: $LINT_REPORT)"
+else
+  echo "sparkdl-lint FAILED — full report: $LINT_REPORT" >&2
+  exit 1
+fi
+if [[ "${1:-}" == "--lint-only" ]]; then
+  exit 0
+fi
+
 # Two lanes (VERDICT r4 #8): the default lane skips @pytest.mark.slow —
 # the multi-process elastic/preemption jobs and full-size model oracles —
 # and finishes under 10 minutes (355 tests in 9:42, idle host,
@@ -31,6 +49,8 @@ assert "sparkdl_bench_images_total" in rec["observability"], rec.keys()
 assert rec["dispatch_count"] == 2, rec
 assert 0 <= rec["overhead_share"] <= 1, rec
 assert "sparkdl_dispatches_total" in rec["observability"], rec.keys()
+# ISSUE 11: static-analysis drift rides the trajectory; HEAD lints clean
+assert rec["lint_findings_total"] == 0, rec["lint_findings_total"]
 print("bench.py contract OK")
 '
 # Fused-dispatch smoke (ISSUE 3): a chained BatchedRunner.run must issue
