@@ -151,7 +151,10 @@ print("bench.py dp contract OK")
 '
 # Online serving bench: same one-JSON-line contract; vs_baseline is the
 # micro-batch / batch-of-1 throughput ratio under open-loop Poisson load.
-JAX_PLATFORMS=cpu BENCH_REQUESTS=64 python bench_serving.py | tail -1 | python -c '
+# BENCH_SPEC_K/BENCH_KV_DTYPE are pinned: the contract below asserts the
+# spec/quant sections, so the ambient environment must not disable them.
+JAX_PLATFORMS=cpu BENCH_REQUESTS=64 BENCH_SPEC_K=4 BENCH_KV_DTYPE=int8 \
+  python bench_serving.py | tail -1 | python -c '
 import json, sys
 rec = json.loads(sys.stdin.readline())
 assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
@@ -186,7 +189,21 @@ assert rec["kv_blocks_used"] > 0, rec["kv_blocks_used"]
 assert rec["prefill_chunks"] > 0, rec["prefill_chunks"]
 assert "sparkdl_kv_blocks_used" in obs, sorted(obs)
 assert "sparkdl_prefix_hits_total" in obs, sorted(obs)
-print("bench_serving contract OK (snapshot + slo + flight + kv embedded)")
+# ISSUE 12: speculative decode + quantized KV — acceptance/dispatch
+# amortization and the capacity ratio embedded in the JSON line, spec
+# tokens bitwise vs k=1, strictly fewer decode dispatches
+sd = rec["spec_decode"]
+assert sd["spec_bitwise_vs_k1"] is True, sd
+assert 0 <= rec["spec_acceptance_rate"] <= 1, rec["spec_acceptance_rate"]
+assert rec["spec_tokens_per_dispatch"] > 1, rec["spec_tokens_per_dispatch"]
+assert sd["spec"]["decode_dispatches"] < sd["k1"]["decode_dispatches"], sd
+assert rec["kv_capacity_ratio"] >= 2.0, rec["kv_capacity_ratio"]
+assert 0 <= sd["kv_quant"]["token_agreement_vs_fp32"] <= 1, sd
+assert "sparkdl_spec_proposed_total" in obs, sorted(obs)
+assert "sparkdl_spec_accepted_total" in obs, sorted(obs)
+assert "sparkdl_kv_pool_dtype" in obs, sorted(obs)
+print("bench_serving contract OK (snapshot + slo + flight + kv + spec "
+      "embedded)")
 '
 
 # Paged-KV smoke (ISSUE 10): (a) a shared-prefix workload through the
@@ -279,6 +296,64 @@ print(f"paged-KV smoke OK: hit_rate {hit_rate:.2f} > 0.5, bitwise vs "
       f"state, healthz degraded during streak")
 EOF
 rm -rf "$FLIGHT_DIR"
+
+# Spec-decode smoke (ISSUE 12): (a) k=4 speculative decode must stay
+# BITWISE identical to the spec-free engine — including while the env
+# fault plan kills two verify dispatches mid-run (spec.verify site:
+# the engine falls back to plain decode for those ticks, zero lost
+# requests); (b) an injected kv.quantize fault fails the compressed-
+# pool build loudly while fp32 builds never hit the site; (c) the int8
+# layout fits >= 2x fp32's live tokens in the same pool bytes.
+JAX_PLATFORMS=cpu \
+SPARKDL_TPU_FAULT_PLAN="spec.verify:RuntimeError@2*2" python - <<'EOF'
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+from sparkdl_tpu.reliability.faults import inject
+from sparkdl_tpu.serving import ContinuousGPTEngine
+from sparkdl_tpu.serving.kv_blocks import kv_capacity_ratio
+
+cfg = GPTConfig.tiny()
+model = GPTLMHeadModel(cfg)
+variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+cases = [([5, 3, 9, 2, 7], 9), ([6, 8, 6, 1, 6, 8, 6, 1], 10), ([1, 4], 7)]
+
+def run(**kw):
+    eng = ContinuousGPTEngine(cfg, variables, n_slots=2, max_len=32,
+                              kv_block_size=4, prefill_chunk=8,
+                              auto_start=False, **kw)
+    futs = [eng.submit(p, n) for p, n in cases]
+    while not all(f.done() for f in futs):
+        eng.tick()
+    eng.close()
+    return [np.asarray(f.result(timeout=0)) for f in futs], eng
+
+# the env plan arms spec.verify@2*2: the 2nd and 3rd verify attempts
+# fail, those ticks serve plain decode, and the stream must STILL be
+# bitwise vs the spec-free engine (which never hits the site)
+base, _ = run()
+spec, eng = run(spec_k=4)
+for a, b in zip(base, spec):
+    np.testing.assert_array_equal(a, b)
+assert eng._spec_fallbacks == 2, eng._spec_fallbacks
+assert eng._spec_dispatches >= 1
+assert eng._spec_accepted > 0
+with inject("kv.quantize:RuntimeError@1"):
+    try:
+        run(kv_dtype="int8")
+        raise SystemExit("kv.quantize fault did not fail the build")
+    except RuntimeError as e:
+        assert "kv.quantize" in str(e), e
+    run()  # fp32 build never hits the armed site
+q, _ = run(kv_dtype="int8")  # compressed pool serves end to end
+assert all(len(o) >= 1 for o in q)
+assert kv_capacity_ratio(cfg, "int8") >= 2.0
+print("spec-decode smoke OK: k=4 bitwise vs k=1 through 2 injected "
+      "verify failures (zero lost requests), kv.quantize fails the "
+      f"int8 build loudly, int8 fits {kv_capacity_ratio(cfg, 'int8'):.1f}x "
+      "fp32 tokens per byte")
+EOF
 
 # Fault-injection smoke (ISSUE 5): resumable_finetune survives an
 # injected crash at step k and its per-step loss trajectory matches the

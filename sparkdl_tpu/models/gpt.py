@@ -108,9 +108,11 @@ def init_cache(config: GPTConfig, batch: int, max_len: int,
     ``idx`` is the number of positions already written — a scalar for the
     lockstep :func:`generate` path, or (``per_slot=True``) a per-row [B]
     vector for continuous-batching serving where every batch row (slot)
-    decodes at its own depth (``serving.continuous``). Per-slot caches
-    support single-token steps only (L==1); prefill a joining row in its
-    own scalar-idx cache and scatter it in.
+    decodes at its own depth (``serving.continuous``). Per-slot steps
+    write this call's L tokens at columns ``[idx[b], idx[b]+L)`` of each
+    row — L=1 is the classic decode step, L=k is the speculative verify
+    pass that scores a whole draft span in one dispatch. Prefill a
+    joining row in its own scalar-idx cache and scatter it in.
     """
     hd = config.hidden_size // config.num_heads
     shape = (config.num_layers, batch, max_len, config.num_heads, hd)
@@ -122,7 +124,7 @@ def init_cache(config: GPTConfig, batch: int, max_len: int,
 
 
 def init_block_pool(config: GPTConfig, n_blocks: int,
-                    block_size: int) -> dict:
+                    block_size: int, dtype: str = "fp32") -> dict:
     """Zeroed block-paged KV pool for continuous serving
     (``serving.kv_blocks``): k/v stacked over layers,
     ``[num_layers, n_blocks, block_size, H, D]``.
@@ -136,14 +138,61 @@ def init_block_pool(config: GPTConfig, n_blocks: int,
     of many slots (``serving.prefix_cache``). Bookkeeping (free list,
     refcounts, tables) is host-side and lives in
     :class:`~sparkdl_tpu.serving.kv_blocks.KVBlockPool`.
+
+    ``dtype`` picks the STORAGE layout (``serving.kv_blocks.KV_DTYPES``):
+
+    - ``"fp32"`` — store at the model's compute dtype (``config.dtype``),
+      the exact layout; gather/scatter are plain copies.
+    - ``"bf16"`` — store bfloat16, dequantize to the compute dtype on
+      gather: half the pool bytes per token.
+    - ``"int8"`` — store int8 with one fp32 scale per written COLUMN
+      (``k_scale``/``v_scale``, ``[num_layers, n_blocks, block_size]``,
+      riding the block structure): ~4x fewer pool bytes per token. The
+      quantize/dequantize math is :func:`quantize_kv` /
+      :func:`dequantize_kv`, fused by the serving engine into its paged
+      gather/scatter programs — compute always runs at ``config.dtype``;
+      only the resident pool is compressed.
     """
     hd = config.hidden_size // config.num_heads
     shape = (config.num_layers, n_blocks, block_size,
              config.num_heads, hd)
-    return {
-        "k": jnp.zeros(shape, config.dtype),
-        "v": jnp.zeros(shape, config.dtype),
+    store = {"fp32": config.dtype, "bf16": jnp.bfloat16,
+             "int8": jnp.int8}.get(dtype)
+    if store is None:
+        raise ValueError(
+            f"unknown KV pool dtype {dtype!r} (fp32 | bf16 | int8)")
+    pool = {
+        "k": jnp.zeros(shape, store),
+        "v": jnp.zeros(shape, store),
     }
+    if dtype == "int8":
+        pool["k_scale"] = jnp.zeros(shape[:3], jnp.float32)
+        pool["v_scale"] = jnp.zeros(shape[:3], jnp.float32)
+    return pool
+
+
+def quantize_kv(x: jax.Array) -> "tuple[jax.Array, jax.Array]":
+    """Symmetric per-column int8 quantization of K/V columns.
+
+    ``x`` is ``[..., H, D]`` (any leading index shape); returns
+    ``(int8 values, fp32 scales[...])`` with one scale per column — the
+    absmax maps to ±127, so requantize(dequantize(q, s)) == (q, s)
+    exactly (the property that makes copy-on-write prefix sharing
+    lossless under int8: a gathered-then-reinstalled block is
+    bit-identical to its donor). Zero columns get a tiny floor scale
+    and quantize to zero.
+    """
+    amax = jnp.max(jnp.abs(x), axis=(-2, -1))
+    scale = (jnp.maximum(amax, 1e-30) / 127.0).astype(jnp.float32)
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None, None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array,
+                  dtype: Any = jnp.float32) -> jax.Array:
+    """Inverse of :func:`quantize_kv`: int8 ``[..., H, D]`` columns and
+    their per-column scales back to ``dtype``."""
+    return (q.astype(jnp.float32) * scale[..., None, None]).astype(dtype)
 
 
 class GPTAttention(nn.Module):
@@ -185,12 +234,6 @@ class GPTAttention(nn.Module):
             # the mask keeps advancing — catch it whenever idx is concrete
             # (eager streaming drivers; generate() pre-validates its scan).
             max_len = cache["k"].shape[2]
-            if per_slot and l != 1:
-                raise ValueError(
-                    "per-slot caches (idx per row) support single-token "
-                    f"decode only, got L={l}; prefill a joining row in its "
-                    "own scalar-idx cache and scatter it into the slot"
-                )
             if (not per_slot and not isinstance(idx, jax.core.Tracer)
                     and int(idx) + l > max_len):
                 raise ValueError(
@@ -198,19 +241,22 @@ class GPTAttention(nn.Module):
                     f"cache max_len {max_len}"
                 )
             if per_slot:
-                # Per-row scatter at column idx[b] — a true indexed
-                # scatter touching B rows, not a masked rewrite of the
-                # whole buffer. mode="drop" keeps the contract for rows
-                # whose idx lies past the buffer (idle/retired slots the
-                # serving engine has not reassigned yet): the write is
-                # dropped (never clamped onto column max_len-1) and the
-                # row stays garbage-but-finite — admission control owns
-                # capacity, not this kernel.
-                rows = jnp.arange(b)
-                ck = cache["k"][self.layer_idx].at[rows, idx].set(
-                    k[:, 0].astype(c.dtype), mode="drop")
-                cv = cache["v"][self.layer_idx].at[rows, idx].set(
-                    v[:, 0].astype(c.dtype), mode="drop")
+                # Per-row scatter at columns [idx[b], idx[b]+L) — a true
+                # indexed scatter touching B x L columns, not a masked
+                # rewrite of the whole buffer (L=1 is the classic decode
+                # step; L=k is the speculative verify span, every row at
+                # its own depth). mode="drop" keeps the contract for
+                # rows whose columns lie past the buffer (idle/retired
+                # slots the serving engine has not reassigned yet): the
+                # write is dropped (never clamped onto column max_len-1)
+                # and the row stays garbage-but-finite — admission
+                # control owns capacity, not this kernel.
+                rows = jnp.arange(b)[:, None]
+                cols = idx[:, None] + jnp.arange(l)[None, :]
+                ck = cache["k"][self.layer_idx].at[rows, cols].set(
+                    k.astype(c.dtype), mode="drop")
+                cv = cache["v"][self.layer_idx].at[rows, cols].set(
+                    v.astype(c.dtype), mode="drop")
             else:
                 ck = jax.lax.dynamic_update_slice(
                     cache["k"][self.layer_idx], k.astype(c.dtype),
@@ -235,7 +281,7 @@ class GPTAttention(nn.Module):
                         attention_mask.astype(jnp.int32), axis=1
                     )
                 ctx = flash_decode(q, ck, cv, idx, start=start)
-            elif (c.attn_impl == "flash" and l > 1
+            elif (c.attn_impl == "flash" and l > 1 and not per_slot
                   and not isinstance(idx, jax.core.Tracer)):
                 # cached PREFILL with concrete idx (generate()'s eager
                 # prefill is always idx=0): flash over the WRITTEN prefix
@@ -355,8 +401,10 @@ class GPTLMHeadModel(nn.Module):
     the building block :func:`generate` scans. A PER-SLOT cache
     (``init_cache(..., per_slot=True)``, ``idx`` [B]) decodes every row at
     its own depth with a per-row causal mask and per-row K/V scatter —
-    single-token steps only, always the dense path — which is what lets
-    ``serving.continuous`` admit and retire rows mid-stream.
+    always the dense path; L=1 is the classic decode step and L=k scores
+    a whole speculative draft span in one pass — which is what lets
+    ``serving.continuous`` admit and retire rows mid-stream and verify
+    k drafted tokens per dispatch.
 
     ``positions``: optional [B, L] global token positions for RoPE.
     REQUIRED under ``attn_impl='ring'`` (sequence sharded on ``sp``): each

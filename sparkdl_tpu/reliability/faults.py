@@ -73,6 +73,8 @@ KNOWN_SITES = (
     "replica.execute",
     "checkpoint.save",
     "kv.alloc",
+    "kv.quantize",
+    "spec.verify",
     "worker.rank",
 )
 

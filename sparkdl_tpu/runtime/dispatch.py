@@ -52,6 +52,7 @@ from sparkdl_tpu.reliability.faults import fault_point
 __all__ = [
     "ChainPolicy",
     "ScanChainer",
+    "SpecPolicy",
     "calibrate_dispatch_gap",
     "chain_carry",
     "default_chain_k",
@@ -233,6 +234,82 @@ class ChainPolicy:
         # the 1e-9 guard keeps float fuzz from bumping an exact power of
         # two (ideal K = 4.0000000001) to the next one
         return min(self.max_chain, 1 << math.ceil(math.log2(k) - 1e-9))
+
+
+@dataclasses.dataclass
+class SpecPolicy:
+    """Pick the speculative verify width from measured acceptance.
+
+    :class:`ChainPolicy` chains k IDENTICAL steps, so its only question
+    is dispatch-gap amortization. A speculative verify chains k
+    *conditional* steps: position j only produces a real token if every
+    draft before it was accepted, so the useful width depends on the
+    measured per-position acceptance rate ``p``. Expected real tokens
+    from a width-k verify are ``E(k) = (1-p^k)/(1-p)`` (a geometric
+    series — each extra position converts with one more factor of p).
+
+    ``spec_len`` returns the largest power-of-two ``k <= max_k`` whose
+    expected utilization stays above ``util`` (``E(k) >= util * k``):
+    below that, the marginal verify positions are mostly wasted FLOPs.
+    Acceptance below ``min_rate`` returns 1 — drafting is not paying
+    for itself and the engine serves plain (chained) decode instead.
+
+    The estimator is a pair of geometrically-decayed counts
+    (proposed/accepted per dispatch), seeded with an OPTIMISTIC prior:
+    cold engines open at full width (the first verifies double as
+    measurement probes — repetitive/shared-prefix workloads, the ones
+    speculation exists for, get their speedup immediately), and one
+    unlucky one-draft dispatch cannot poison the estimate the way a
+    plain EMA of per-dispatch ratios would. Stood-down is NOT
+    terminal: every ``probe_every``-th consultation while below
+    ``min_rate`` returns a width-2 probation probe — the same
+    reintegration discipline as quarantined replicas — so a workload
+    that turns acceptance-friendly again is re-detected without any
+    operator action.
+    """
+
+    max_k: int = 8
+    util: float = 0.5
+    min_rate: float = 0.2
+    decay: float = 0.2
+    prior: float = 8.0
+    probe_every: int = 16
+
+    def __post_init__(self) -> None:
+        self._proposed = self.prior
+        self._accepted = self.prior
+        self._stood_down = 0
+
+    @property
+    def rate(self) -> float:
+        """Decayed-count acceptance estimate (optimistic at cold)."""
+        return self._accepted / self._proposed
+
+    def record(self, proposed: int, accepted: int) -> None:
+        if proposed < 1:
+            return
+        self._proposed = (1 - self.decay) * self._proposed + proposed
+        self._accepted = (1 - self.decay) * self._accepted + accepted
+
+    def expected_tokens(self, k: int) -> float:
+        """E(k) under the current acceptance estimate."""
+        p = min(max(self.rate, 0.0), 0.999999)
+        return (1.0 - p ** k) / (1.0 - p)
+
+    def spec_len(self) -> int:
+        if self.max_k < 2:
+            return 1
+        if self.rate < self.min_rate:
+            self._stood_down += 1
+            if self._stood_down % self.probe_every == 0:
+                return 2  # probation probe: re-measure acceptance
+            return 1
+        self._stood_down = 0
+        k = 2
+        while (2 * k <= self.max_k
+               and self.expected_tokens(2 * k) >= self.util * 2 * k):
+            k *= 2
+        return k
 
 
 def default_chain_k() -> "int | None":

@@ -25,7 +25,10 @@ Three layers, separately testable:
   :mod:`~sparkdl_tpu.serving.prefix_cache` radix prefix reuse +
   chunked prefill): memory bounded by live tokens, shared prompt
   prefixes served from cache, exhausted-pool admissions deferred in
-  order;
+  order; opt-in speculative multi-token decoding
+  (:mod:`~sparkdl_tpu.serving.spec_decode` draft proposers, one
+  verify dispatch per span, exact greedy acceptance) and bf16/int8
+  quantized pool layouts;
 - :mod:`~sparkdl_tpu.serving.replicas` — multi-device replica serving:
   one pinned jit-cached executor per local chip, micro-batches routed
   whole by least outstanding work, quarantine-on-repeated-failure, with
@@ -56,9 +59,15 @@ from sparkdl_tpu.serving.replicas import (
     HungDispatchError,
     ReplicaPool,
 )
+from sparkdl_tpu.serving.spec_decode import (
+    ChainedDraftSource,
+    NGramDraftSource,
+    PrefixCacheDraftSource,
+)
 
 __all__ = [
     "AllReplicasQuarantinedError",
+    "ChainedDraftSource",
     "ContinuousGPTEngine",
     "DeadlineExceededError",
     "EngineClosedError",
@@ -66,7 +75,9 @@ __all__ = [
     "HungDispatchError",
     "KVBlockPool",
     "MicroBatcher",
+    "NGramDraftSource",
     "PrefixCache",
+    "PrefixCacheDraftSource",
     "QueueFullError",
     "ReplicaPool",
     "Request",

@@ -39,6 +39,36 @@ KV layouts (``kv_layout=``, ROADMAP item 4):
   stay oracle-identical on every path (tests/serving/test_kv_paged.py).
 - ``"dense"`` — the original one-dense-buffer-per-slot layout, kept as
   the parity oracle and fallback.
+
+Speculative multi-token decoding (``spec_k=``, ROADMAP item 3): a
+draft source (:mod:`~sparkdl_tpu.serving.spec_decode` — radix-trie
+continuations + n-gram self-lookup by default, any ``propose()``
+object, e.g. a small draft model, via ``draft_source=``) proposes up
+to ``k-1`` tokens per live slot, and ONE verify dispatch scores the
+whole span (the L=k per-slot step in models/gpt.py): every accepted
+draft token is a decode dispatch never issued. Greedy acceptance is
+exact, so accepted tokens are bitwise-identical to one-token-at-a-time
+decode at every draft length — the engine's oracle contract extends
+unchanged (tests/serving/test_spec_decode.py). The verify width is
+re-bounded every tick by the same budget/deadline caps as
+``chain_tokens`` plus the measured acceptance rate
+(:class:`~sparkdl_tpu.runtime.dispatch.SpecPolicy`). The
+``spec.verify`` fault site fires BEFORE the verify is dispatched (the
+injectable stand-in for a verify that cannot run): the tick falls back
+to plain decode — zero lost requests. An error raised by the dispatch
+itself is NOT caught: the pool buffer is donated, so there is no valid
+state to fall back to — it propagates like any decode-dispatch error
+(the engine loop fails every pending Future loudly rather than serving
+from a consumed cache).
+
+Quantized KV blocks (``kv_dtype=``): the paged pool can store
+``"bf16"`` or ``"int8"`` (one fp32 scale per written column) instead
+of the compute dtype — quantize-on-scatter / dequantize-on-gather are
+fused into the existing paged gather/scatter programs, so pool
+capacity (and deferred-admission pressure) improves 2-4x
+(:func:`~sparkdl_tpu.serving.kv_blocks.kv_capacity_ratio`) while
+compute still runs at the model dtype; bench_serving's dense-vs-paged
+parity harness measures the quality trade.
 """
 
 from __future__ import annotations
@@ -57,8 +87,13 @@ from sparkdl_tpu.observability import slo as slo_mod
 from sparkdl_tpu.observability import tracing
 from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.observability.tracing import span
+from sparkdl_tpu.reliability.faults import fault_point
 from sparkdl_tpu.runtime.completion import start_fetch
-from sparkdl_tpu.runtime.dispatch import ChainPolicy, record_dispatch
+from sparkdl_tpu.runtime.dispatch import (
+    ChainPolicy,
+    SpecPolicy,
+    record_dispatch,
+)
 from sparkdl_tpu.serving.metrics import ServingMetrics
 from sparkdl_tpu.serving.queue import (
     DeadlineExceededError,
@@ -72,6 +107,28 @@ from sparkdl_tpu.serving.queue import (
 _M_PREFILL_CHUNKS = registry().counter(
     "sparkdl_prefill_chunks_total",
     "bounded prefill chunks dispatched by continuous GPT engines")
+
+_M_SPEC_PROPOSED = registry().counter(
+    "sparkdl_spec_proposed_total",
+    "draft tokens proposed to speculative verify dispatches")
+_M_SPEC_ACCEPTED = registry().counter(
+    "sparkdl_spec_accepted_total",
+    "proposed draft tokens accepted by greedy verify (each one a "
+    "decode dispatch never issued)")
+_M_SPEC_RATE = registry().gauge(
+    "sparkdl_spec_acceptance_rate",
+    "cumulative accepted/proposed draft share across this process's "
+    "speculative engines")
+_M_SPEC_FALLBACKS = registry().counter(
+    "sparkdl_spec_fallbacks_total",
+    "speculative verify dispatches abandoned to plain decode "
+    "(spec.verify fault site)")
+
+#: Process-wide propose/accept totals behind the acceptance-rate gauge.
+#: Several engines contribute from their own loop threads — their
+#: engine locks are DIFFERENT locks, so this shared state needs its own.
+_SPEC_TOTALS = {"proposed": 0, "accepted": 0}
+_SPEC_TOTALS_LOCK = threading.Lock()
 
 #: Consecutive pool-exhaustion deferrals before the flight recorder
 #: writes a postmortem (one defer is normal backpressure; a streak is
@@ -97,6 +154,9 @@ class _InFlight:
     produced: list[int]
     max_new: int
     blocks: "list[int] | None" = None
+    #: prompt ids (paged layout): the draft proposer's context is
+    #: prompt + produced — ids only, never device state
+    prompt: "np.ndarray | None" = None
 
 
 @dataclasses.dataclass
@@ -165,6 +225,17 @@ class ContinuousGPTEngine:
     p99 latency does not regress. Greedy tokens are identical at any k.
     None = auto-calibrate from the dispatch gap; 1 (default) = one
     token per dispatch, the exact pre-chaining tick semantics.
+
+    ``spec_k`` (paged layout) turns on speculative decoding: up to
+    ``spec_k - 1`` draft tokens per slot (from ``draft_source``,
+    default radix-trie + n-gram — :mod:`serving.spec_decode`) are
+    verified by one L=k target-model dispatch; accepted tokens are
+    bitwise-identical to plain decode, and the verify width shrinks
+    under the same budget/deadline caps as ``chain_tokens`` plus the
+    measured acceptance rate. None (default) = off. ``kv_dtype``
+    ("fp32" | "bf16" | "int8") picks the paged pool's storage layout;
+    quantize/dequantize are fused into the paged programs and compute
+    stays at the model dtype.
     """
 
     def __init__(self, config, variables, *, n_slots: int = 8,
@@ -176,6 +247,9 @@ class ContinuousGPTEngine:
                  kv_block_size: int = 16,
                  kv_blocks: "int | None" = None,
                  prefill_chunk: "int | None" = None,
+                 spec_k: "int | None" = None,
+                 draft_source: Any = None,
+                 kv_dtype: str = "fp32",
                  metrics: ServingMetrics | None = None,
                  slo: "slo_mod.SLO | None" = None,
                  auto_start: bool = True):
@@ -200,6 +274,18 @@ class ContinuousGPTEngine:
             raise ValueError(
                 f"kv_layout must be 'paged' or 'dense', got {kv_layout!r}"
             )
+        if spec_k is not None and spec_k < 2:
+            raise ValueError(
+                f"spec_k must be >= 2 (one draft + its verify), got "
+                f"{spec_k}; None disables speculative decoding"
+            )
+        if kv_layout != "paged" and (spec_k is not None
+                                     or kv_dtype != "fp32"):
+            raise ValueError(
+                "speculative decoding (spec_k) and quantized KV pools "
+                "(kv_dtype) require kv_layout='paged'; the dense layout "
+                "is the exact parity oracle"
+            )
         if (config.positions == "learned"
                 and max_len > config.max_seq_len):
             raise ValueError(
@@ -214,6 +300,15 @@ class ContinuousGPTEngine:
         self.idle_wait_s = idle_wait_s
         self.chain_tokens = chain_tokens
         self.kv_layout = kv_layout
+        self.spec_k = spec_k
+        self.kv_dtype = kv_dtype if kv_layout == "paged" else "fp32"
+        self._spec_policy = (SpecPolicy(max_k=spec_k)
+                             if spec_k is not None else None)
+        self._spec_dispatches = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_tokens = 0
+        self._spec_fallbacks = 0
         self._chain_policy = ChainPolicy(
             max_chain=chain_tokens if chain_tokens is not None else 32
         )
@@ -231,7 +326,6 @@ class ContinuousGPTEngine:
         self._prefill_seconds = 0.0
         self._prefill_chunks = 0
         self._deferrals = 0
-        self._defer_streak = 0
         self._max_tick_prefill_tokens = 0
         self._prefill_rr = 0
         self._lock = threading.Lock()
@@ -242,8 +336,12 @@ class ContinuousGPTEngine:
 
         if kv_layout == "paged":
             from sparkdl_tpu.ingest.pipeline import resolve_pin
+            from sparkdl_tpu.models.gpt import dequantize_kv, quantize_kv
             from sparkdl_tpu.serving.kv_blocks import KVBlockPool
             from sparkdl_tpu.serving.prefix_cache import PrefixCache
+            from sparkdl_tpu.serving.spec_decode import (
+                default_draft_source,
+            )
 
             if kv_block_size < 1:
                 raise ValueError(
@@ -284,9 +382,20 @@ class ContinuousGPTEngine:
             self._mb = mb
             self._w = w
             self._wp = wp
-            self._pool = KVBlockPool(kv_blocks, bs_kv)
+            if kv_dtype != "fp32":
+                # the bring-up of a COMPRESSED pool is a distinct
+                # failure surface (scale buffers, storage casts) the
+                # chaos harness must reach: an injected kv.quantize
+                # fault fails construction loudly BEFORE any
+                # process-wide registration leaks (gauges register
+                # below, EngineObservability last)
+                fault_point("kv.quantize")
+            self._pool = KVBlockPool(kv_blocks, bs_kv, dtype=kv_dtype)
             self._prefix = PrefixCache(self._pool)
-            self._pool_kv = init_block_pool(config, kv_blocks, bs_kv)
+            self._draft = (draft_source if draft_source is not None
+                           else default_draft_source(self._prefix))
+            self._pool_kv = init_block_pool(config, kv_blocks, bs_kv,
+                                            dtype=kv_dtype)
             # block tables: one row per slot, sentinel (= kv_blocks)
             # marks empty entries — gather clips it, scatter drops it
             self._table = np.full((n_slots, mb), self._pool.sentinel,
@@ -297,6 +406,48 @@ class ContinuousGPTEngine:
             hd = config.hidden_size // config.num_heads
             max_pos = (config.max_seq_len - 1
                        if config.positions == "learned" else wp + chunk)
+            cdt = config.dtype
+
+            # The dtype boundary, fused into every paged program: the
+            # pool is the only compressed tensor — compute (attention,
+            # private prefill caches) always runs at the model dtype.
+            # int8 carries one fp32 scale per written column
+            # (models.gpt.quantize_kv), riding the block structure in
+            # pool["k_scale"]/["v_scale"].
+            def _dq_gather(pool, name, ids):
+                # pool[name][:, ids] in storage dtype -> compute dtype
+                x = pool[name][:, ids]
+                if kv_dtype == "int8":
+                    return dequantize_kv(
+                        x, pool[name + "_scale"][:, ids], cdt)
+                return x if kv_dtype == "fp32" else x.astype(cdt)
+
+            def _q_write(pool, where, newk, newv):
+                # THE quantize-on-write path (every pool write goes
+                # through here, so scatter and install can never
+                # desynchronize): ``where`` is the advanced index after
+                # the layer axis — (blk, off) column tuples for decode/
+                # verify scatter, (ids,) whole blocks for the prefill
+                # install. int8 writes values + their per-column scales;
+                # sentinel entries drop — no block corrupted.
+                ix = (slice(None),) + where
+                out = dict(pool)
+                for name, vals in (("k", newk), ("v", newv)):
+                    if kv_dtype == "int8":
+                        q, s = quantize_kv(vals)
+                        out[name] = pool[name].at[ix].set(
+                            q, mode="drop")
+                        sc = name + "_scale"
+                        out[sc] = pool[sc].at[ix].set(s, mode="drop")
+                    else:
+                        out[name] = pool[name].at[ix].set(
+                            vals.astype(pool[name].dtype), mode="drop")
+                return out
+
+            def _q_scatter(pool, blk, off, newk, newv):
+                # freshly written columns; blk/off share any index
+                # shape ([S] decode, [S,k] verify)
+                return _q_write(pool, (blk, off), newk, newv)
 
             @functools.partial(jax.jit, donate_argnums=(1,),
                                static_argnums=(5, 6))
@@ -321,9 +472,9 @@ class ContinuousGPTEngine:
 
                 def body(carry, _):
                     pool, idx, tok = carry
-                    kbuf = pool["k"][:, sub].reshape(
+                    kbuf = _dq_gather(pool, "k", sub).reshape(
                         n_layers, n_slots, nb * bs_kv, nh, hd)
-                    vbuf = pool["v"][:, sub].reshape(
+                    vbuf = _dq_gather(pool, "v", sub).reshape(
                         n_layers, n_slots, nb * bs_kv, nh, hd)
                     cache = {"k": kbuf, "v": vbuf, "idx": idx}
                     logits, cache = model.apply(
@@ -335,12 +486,7 @@ class ContinuousGPTEngine:
                     off = idx % bs_kv
                     newk = cache["k"][:, rows, idx]
                     newv = cache["v"][:, rows, idx]
-                    pool = {
-                        "k": pool["k"].at[:, blk, off].set(
-                            newk, mode="drop"),
-                        "v": pool["v"].at[:, blk, off].set(
-                            newv, mode="drop"),
-                    }
+                    pool = _q_scatter(pool, blk, off, newk, newv)
                     return (pool, idx + 1, ntok), ntok
 
                 (pool, _, _), toks = lax.scan(
@@ -348,14 +494,54 @@ class ContinuousGPTEngine:
                 )
                 return toks, pool
 
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               static_argnums=(5, 6))
+            def _paged_verify(variables, pool, table, idx, toks, k, nb):
+                # Speculative verify: score a k-token span for every
+                # slot in ONE dispatch. Column 0 of ``toks`` is each
+                # slot's current last token, columns 1.. its proposed
+                # drafts; the L=k per-slot step (models/gpt.py) writes
+                # all k columns at [idx[s], idx[s]+k) and the per-row
+                # causal mask conditions position j on the real context
+                # plus drafts [:j] — exactly the logits greedy
+                # acceptance needs, same gather/scatter shape as
+                # _paged_step so greedy tokens stay bitwise. Columns of
+                # REJECTED drafts scatter back as garbage PAST the
+                # accepted frontier (the host advances pidx only over
+                # accepted inputs): they sit causally masked until the
+                # next dispatch's own writes overwrite them — the same
+                # garbage-but-finite contract as retired-slot columns.
+                sub = table[:, :nb]
+                kbuf = _dq_gather(pool, "k", sub).reshape(
+                    n_layers, n_slots, nb * bs_kv, nh, hd)
+                vbuf = _dq_gather(pool, "v", sub).reshape(
+                    n_layers, n_slots, nb * bs_kv, nh, hd)
+                cache = {"k": kbuf, "v": vbuf, "idx": idx}
+                logits, cache = model.apply(variables, toks, cache=cache)
+                out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                rows = jnp.arange(n_slots)[:, None]
+                pos = idx[:, None] + jnp.arange(k)[None, :]
+                blk = table[rows, pos // bs_kv]
+                off = pos % bs_kv
+                newk = cache["k"][:, rows, pos]
+                newv = cache["v"][:, rows, pos]
+                return out, _q_scatter(pool, blk, off, newk, newv)
+
             def _gathered(pool, ids):
                 # cached-prefix blocks -> the head of a private prefill
                 # cache (the copy that makes partial-block sharing
                 # copy-on-write: the sharer re-installs into blocks it
                 # owns, the donor block is never written). Sentinel ids
                 # clip to garbage the chunked prefill masks/overwrites.
-                kx = pool["k"][:, ids].reshape(n_layers, 1, w, nh, hd)
-                vx = pool["v"][:, ids].reshape(n_layers, 1, w, nh, hd)
+                # Quantized pools dequantize here: the private cache is
+                # compute-dtype, and the final install requantizes —
+                # an exact round trip (quantize_kv absmax maps to ±127),
+                # so a COW-shared block re-installs bit-identical to its
+                # donor.
+                kx = _dq_gather(pool, "k", ids).reshape(
+                    n_layers, 1, w, nh, hd)
+                vx = _dq_gather(pool, "v", ids).reshape(
+                    n_layers, 1, w, nh, hd)
                 pad = ((0, 0), (0, 0), (0, wp - w), (0, 0), (0, 0))
                 return jnp.pad(kx, pad), jnp.pad(vx, pad)
 
@@ -387,16 +573,14 @@ class ContinuousGPTEngine:
                 return logits, ck, cv
 
             def _installed(pool, ck, cv, ids):
-                # private prefill cache -> the slot's OWNED pool blocks.
+                # private prefill cache -> the slot's OWNED pool blocks
+                # (quantize-on-install rides the shared _q_write path).
                 # ids carries the sentinel at shared-prefix positions
                 # (their content already lives in the shared blocks) and
                 # past the covered span: those writes drop.
                 kv = ck[:, 0, :w].reshape(n_layers, mb, bs_kv, nh, hd)
                 vv = cv[:, 0, :w].reshape(n_layers, mb, bs_kv, nh, hd)
-                return {
-                    "k": pool["k"].at[:, ids].set(kv, mode="drop"),
-                    "v": pool["v"].at[:, ids].set(vv, mode="drop"),
-                }
+                return _q_write(pool, (ids,), kv, vv)
 
             # Four fused chunk programs so a prefill pays the minimum
             # dispatch count (dispatch gap dominates small programs —
@@ -436,6 +620,7 @@ class ContinuousGPTEngine:
                 return logits, _installed(pool, ck, cv, inst)
 
             self._paged_step_fn = _paged_step
+            self._paged_verify_fn = _paged_verify
             self._chunk_one_fn = _chunk_one
             self._chunk_first_fn = _chunk_first
             self._chunk_mid_fn = _chunk_mid
@@ -678,15 +863,17 @@ class ContinuousGPTEngine:
                         self._defer(reqs[i:])
                         deferred = True
                         break
-                if not deferred and self._defer_streak:
+                if (not deferred and self.kv_layout == "paged"
+                        and self._pool.deferral_streak):
                     # free slots existed and nothing deferred this tick
                     # (the deferred work admitted, or left the queue —
                     # e.g. expired): the exhaustion episode is over. A
                     # streak must never outlive the pressure, or an
                     # idle, recovered engine would read degraded
                     # forever and the next real incident would miss its
-                    # postmortem trigger.
-                    self._defer_streak = 0
+                    # postmortem trigger. (The pool also clears the
+                    # streak itself whenever release() frees blocks.)
+                    self._pool.reset_deferral_streak()
             else:
                 self.queue.sweep_expired()  # deadlines don't wait for slots
             did_work = False
@@ -705,21 +892,27 @@ class ContinuousGPTEngine:
         pool state). Self-recovering: blocks free as slots retire."""
         self.queue.requeue(reqs)
         self._deferrals += 1
-        self._defer_streak += 1
-        self._pool.record_deferral()
+        gen: GenRequest = reqs[0].payload
+        # the recovery bar: worst-case blocks of the request being owed
+        # (ignores prefix-cache sharing — a conservative overestimate,
+        # so a partial free can never clear a streak the request's
+        # admission would still defer on)
+        need = -(-(len(gen.prompt) + gen.max_new_tokens) // self._kv_bs)
+        self._pool.record_deferral(need=need)
+        streak = self._pool.deferral_streak
         flight_mod.record_event(
             "kv.admission_deferred",
             engine=getattr(self._obs, "name", None),
             request_id=reqs[0].request_id,
             deferred=len(reqs),
-            streak=self._defer_streak,
+            streak=streak,
             blocks_free=self._pool.free_count,
             blocks_total=self._pool.n_blocks,
         )
-        if self._defer_streak == _EXHAUST_DUMP_STREAK:
+        if streak == _EXHAUST_DUMP_STREAK:
             flight_mod.trigger_dump(
                 "kv.pool_exhausted",
-                streak=self._defer_streak,
+                streak=streak,
                 blocks_total=self._pool.n_blocks,
             )
 
@@ -817,7 +1010,7 @@ class ContinuousGPTEngine:
             gather_ids=gids, install_ids=inst,
             cow_block=m.partial_block,
         )
-        self._defer_streak = 0
+        self._pool.reset_deferral_streak()
         return True
 
     def _alloc_blocks(self, n: int) -> "list[int] | None":
@@ -929,7 +1122,8 @@ class ContinuousGPTEngine:
         self._last_tok[slot] = first
         del self._prefilling[slot]
         flight = _InFlight(st.req, [first], st.max_new,
-                           blocks=st.shared + st.owned)
+                           blocks=st.shared + st.owned,
+                           prompt=st.prompt)
         self._inflight[slot] = flight
         if self._is_done(flight):  # max_new_tokens=1, or instant eos
             self._complete(slot)
@@ -946,20 +1140,16 @@ class ContinuousGPTEngine:
         if blocks:
             self._prefix.release(blocks)
 
-    def _decode_chain_len(self, now: float) -> int:
-        """Tokens to fuse into the next decode dispatch.
-
-        Bounded by (a) the configured/auto cap, (b) the smallest
+    def _bounded_tokens(self, now: float, cap: int) -> int:
+        """Clamp a per-dispatch token count to (a) the smallest
         remaining token budget in flight — the earliest possible
         retirement, so no slot is held past its scheduled exit and no
-        decoded token is wasted on budget grounds — and (c) the tightest
+        decoded token is wasted on budget grounds — and (b) the tightest
         in-flight deadline over the measured per-token time (2x safety),
-        so a request never expires inside a chain it could have survived.
-        Rounded down to a power of two: at most log2(cap) compiled chain
-        programs ever exist.
-        """
-        cap = (self.chain_tokens if self.chain_tokens is not None
-               else self._chain_policy.chain_len())
+        so a request never expires inside a dispatch it could have
+        survived. Shared by the chained decode AND the speculative
+        verify width — budget/deadline semantics cannot drift between
+        the two."""
         cap = min(cap, *(
             f.max_new - len(f.produced) for f in self._inflight.values()
         ))
@@ -975,13 +1165,151 @@ class ContinuousGPTEngine:
             # first dispatch doubles as the measurement probe at k=1 so
             # a request can never expire inside an unmeasured chain
             return 1
+        return cap
+
+    def _decode_chain_len(self, now: float) -> int:
+        """Tokens to fuse into the next plain decode dispatch: the
+        configured/auto cap under the shared budget/deadline bound,
+        rounded down to a power of two — at most log2(cap) compiled
+        chain programs ever exist."""
+        cap = (self.chain_tokens if self.chain_tokens is not None
+               else self._chain_policy.chain_len())
+        cap = self._bounded_tokens(now, cap)
         if cap <= 1:
             return 1
         return 1 << (cap.bit_length() - 1)
 
+    def _spec_width(self, now: float) -> int:
+        """Verify width (1 + drafts) for the next speculative dispatch:
+        the configured ``spec_k`` cap shrunk by the measured acceptance
+        rate (SpecPolicy — wasted verify positions are real FLOPs) and
+        the same budget/deadline bound as ``chain_tokens``, so a
+        deadline-tight stream degrades to plain single-token decode
+        mid-flight instead of expiring inside a wide verify. Power of
+        two: {2,4,8,...} compiled verify programs, never one per width.
+        """
+        cap = min(self.spec_k, self._spec_policy.spec_len())
+        cap = self._bounded_tokens(now, cap)
+        if cap < 2:
+            return 1
+        return 1 << (cap.bit_length() - 1)
+
+    def _spec_step(self) -> bool:
+        """One propose -> verify -> accept quantum. Returns True when a
+        verify dispatch advanced the batch (the tick's decode is done);
+        False when speculation stood down this tick — width bounded
+        below 2, no proposer had a draft, or the ``spec.verify`` fault
+        site fired (the chaos contract: a failed verify falls back to
+        plain decode, zero lost requests)."""
+        import jax
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.runtime.batching import pow2_bucket
+        from sparkdl_tpu.serving.spec_decode import greedy_accept
+
+        k = self._spec_width(time.monotonic())
+        if k < 2:
+            return False
+        # propose per live slot (ids only, host-side): context is
+        # prompt + produced. Slots whose proposer stands down ride the
+        # dispatch with filler drafts — the verify batch is all
+        # n_slots wide regardless, rejection costs them nothing, and
+        # an accidental filler match is by construction the argmax
+        # (i.e. a correct token).
+        drafts = np.zeros((self.n_slots, k - 1), np.int32)
+        real_len: "dict[int, int]" = {}
+        proposed = 0
+        for slot, f in self._inflight.items():
+            ctx = np.concatenate(
+                [f.prompt, np.asarray(f.produced, np.int32)])
+            got = self._draft.propose(ctx, k - 1)[:k - 1]
+            real_len[slot] = len(got)
+            proposed += len(got)
+            if got:
+                drafts[slot, :len(got)] = got
+        if not proposed:
+            return False
+        try:
+            # the injectable stand-in for a failed verify dispatch: it
+            # fires BEFORE the jitted call so the donated pool is never
+            # half-consumed, and the tick serves everyone through the
+            # plain decode path instead
+            fault_point("spec.verify")
+        except Exception as e:
+            self._spec_fallbacks += 1
+            _M_SPEC_FALLBACKS.inc()
+            flight_mod.record_event(
+                "spec.verify_failed",
+                engine=getattr(self._obs, "name", None),
+                error=type(e).__name__, k=k,
+                slots=len(self._inflight))
+            return False
+        toks = np.concatenate(
+            [np.asarray(self._last_tok[:, None], np.int32), drafts],
+            axis=1)
+        need = max(self._pidx[s] for s in self._inflight) + k
+        nb = pow2_bucket(-(-need // self._kv_bs), 1, self._mb)
+        t0 = time.perf_counter()
+        links = ([f.req.request_id for f in self._inflight.values()]
+                 if tracing.tracing_enabled() else ())
+        with span("serving.spec_verify", slots=len(self._inflight),
+                  k=k, links=links):
+            out, self._pool_kv = self._paged_verify_fn(
+                self.variables, self._pool_kv,
+                jnp.asarray(self._table), jnp.asarray(self._pidx),
+                jnp.asarray(toks), k, nb,
+            )
+            fetch = start_fetch(out, path="decode")
+            jax.block_until_ready(out)
+            # sparkdl-lint: disable=blocking-in-hot-loop -- block_until_ready above completed the dispatch; only the already-enqueued D2H copy remains
+            out = np.asarray(fetch.result())
+        wall = time.perf_counter() - t0
+        record_dispatch("decode", k, wall)
+        # the deadline bound's per-token estimate: a width-k verify is
+        # ~ONE model pass (weight-bound regime), so record it as one
+        # step — recording k would shrink program_s k-fold and let
+        # _bounded_tokens fuse plain chains far past a deadline's real
+        # headroom. Slightly overestimating per-token cost (L=k costs
+        # ~1.2x L=1) only makes the deadline caps more conservative.
+        self._chain_policy.record(wall, 1)
+        self.metrics.record_batch(len(self._inflight), self.n_slots)
+        self._spec_dispatches += 1
+        accepted = 0
+        for slot in list(self._inflight):
+            flight = self._inflight[slot]
+            m = greedy_accept(drafts[slot], out[slot, :k - 1])
+            accepted += min(m, real_len.get(slot, 0))
+            # outputs [:m+1] are real greedy tokens (m accepted drafts
+            # + the bonus/correction); append with the SAME per-token
+            # retire semantics as the chained path — eos or budget
+            # mid-span drops the rest and frees the slot now
+            for j in range(m + 1):
+                flight.produced.append(int(out[slot, j]))
+                self._last_tok[slot] = out[slot, j]
+                self._pidx[slot] += 1
+                self._spec_tokens += 1
+                if self._is_done(flight):
+                    self._complete(slot)
+                    break
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        self._spec_policy.record(proposed, accepted)
+        _M_SPEC_PROPOSED.inc(proposed)
+        if accepted:
+            _M_SPEC_ACCEPTED.inc(accepted)
+        with _SPEC_TOTALS_LOCK:
+            _SPEC_TOTALS["proposed"] += proposed
+            _SPEC_TOTALS["accepted"] += accepted
+            _M_SPEC_RATE.set(
+                _SPEC_TOTALS["accepted"] / _SPEC_TOTALS["proposed"])
+        return True
+
     def _decode_step(self) -> None:
         import jax.numpy as jnp
 
+        if (self.spec_k is not None and self.kv_layout == "paged"
+                and self._spec_step()):
+            return
         k = self._decode_chain_len(time.monotonic())
         t0 = time.perf_counter()
         # decode ticks are batch-level: their spans link every rider's
@@ -1163,6 +1491,11 @@ class ContinuousGPTEngine:
         return out
 
     def _kv_snapshot(self) -> "dict[str, Any] | None":
+        from sparkdl_tpu.serving.kv_blocks import (
+            kv_bytes_per_token,
+            kv_capacity_ratio,
+        )
+
         if self.kv_layout != "paged":
             return None
         return {
@@ -1177,7 +1510,30 @@ class ContinuousGPTEngine:
             "prefill_chunk": self.prefill_chunk,
             "prefill_chunks": self._prefill_chunks,
             "deferrals_total": self._deferrals,
-            "exhausted_streak": self._defer_streak,
+            "exhausted_streak": self._pool.deferral_streak,
+            "dtype": self.kv_dtype,
+            "bytes_per_token": kv_bytes_per_token(
+                self.config, self.kv_dtype),
+            "capacity_ratio_vs_fp32": round(kv_capacity_ratio(
+                self.config, self.kv_dtype), 4),
+        }
+
+    def _spec_snapshot(self) -> "dict[str, Any] | None":
+        if self.spec_k is None:
+            return None
+        return {
+            "spec_k": self.spec_k,
+            "dispatches": self._spec_dispatches,
+            "fallbacks": self._spec_fallbacks,
+            "proposed": self._spec_proposed,
+            "accepted": self._spec_accepted,
+            "acceptance_rate": (
+                round(self._spec_accepted / self._spec_proposed, 4)
+                if self._spec_proposed else None),
+            "tokens": self._spec_tokens,
+            "tokens_per_dispatch": (
+                round(self._spec_tokens / self._spec_dispatches, 4)
+                if self._spec_dispatches else None),
         }
 
     def _flight_context(self) -> dict:
@@ -1190,6 +1546,9 @@ class ContinuousGPTEngine:
             # healthz_report aggregates this shape: a nonzero
             # exhaustion streak reads as degraded (self-recovering)
             out["kv_pool"] = kv
+        spec = self._spec_snapshot()
+        if spec is not None:
+            out["spec"] = spec
         return out
 
     def snapshot(self) -> dict[str, Any]:
@@ -1199,6 +1558,7 @@ class ContinuousGPTEngine:
         out["kv_layout"] = self.kv_layout
         out["prefill_seconds"] = self._prefill_seconds
         out["kv"] = self._kv_snapshot()
+        out["spec"] = self._spec_snapshot()
         out["slo"] = (self.slo_tracker.sample()
                       if self.slo_tracker is not None else None)
         return out

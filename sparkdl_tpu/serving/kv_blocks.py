@@ -23,6 +23,17 @@ delta contributions (several pools may live in one process; each adds
 its share instead of clobbering the others — the RequestQueue depth
 pattern) and carries the ``kv.alloc`` fault site so the chaos harness
 can simulate exhaustion deterministically.
+
+Quantized layouts (ROADMAP item 3): the pool's DEVICE storage
+(:func:`~sparkdl_tpu.models.gpt.init_block_pool`) can hold blocks in
+``bf16`` or ``int8`` (one fp32 scale per written column) instead of the
+compute dtype — :data:`KV_DTYPES`. This class stays dtype-agnostic
+bookkeeping; it records the layout for observability
+(``sparkdl_kv_pool_dtype{dtype=...}`` counts live pools per layout) and
+:func:`kv_bytes_per_token` / :func:`kv_capacity_ratio` give the sizing
+arithmetic benches and admission math share: int8 fits 2-4x the live
+tokens of fp32 in the same pool bytes, which is directly more
+concurrent users per chip.
 """
 
 from __future__ import annotations
@@ -41,6 +52,47 @@ _M_USED = registry().gauge(
 _M_DEFERRED = registry().counter(
     "sparkdl_kv_admission_deferred_total",
     "admissions re-queued because the KV block pool was exhausted")
+_M_DTYPE = registry().gauge(
+    "sparkdl_kv_pool_dtype",
+    "live KV block pools by storage layout", labels=("dtype",))
+
+#: Supported pool storage layouts: "fp32" stores at the model's compute
+#: dtype (exact, the default), "bf16"/"int8" compress the resident pool
+#: (compute still runs at the model dtype; see models.gpt.quantize_kv).
+KV_DTYPES = ("fp32", "bf16", "int8")
+
+_KV_ITEMSIZE = {"bf16": 2, "int8": 1}
+
+
+def kv_bytes_per_token(config, dtype: str = "fp32") -> int:
+    """Resident pool bytes one cached token costs under ``dtype``:
+    K + V columns across every layer, plus (int8) the two per-column
+    fp32 scales. Pure arithmetic — the number benches assert capacity
+    ratios with and operators size pools by. The ``"fp32"`` layout
+    stores at the MODEL's compute dtype (``config.dtype``, usually
+    float32), so a bf16-compute model honestly reports the native
+    layout at 2 bytes/element — and near-zero gain from the "bf16"
+    layout."""
+    import numpy as np
+
+    if dtype not in KV_DTYPES:
+        raise ValueError(
+            f"unknown KV dtype {dtype!r} (one of {KV_DTYPES})")
+    item = (np.dtype(config.dtype).itemsize if dtype == "fp32"
+            else _KV_ITEMSIZE[dtype])
+    hd = config.hidden_size // config.num_heads
+    per_layer = 2 * config.num_heads * hd * item
+    if dtype == "int8":
+        per_layer += 2 * 4  # k_scale + v_scale, fp32, one per column
+    return config.num_layers * per_layer
+
+
+def kv_capacity_ratio(config, dtype: str) -> float:
+    """How many live tokens ``dtype`` fits per NATIVE-layout token in
+    the same pool bytes (>= 2.0 for int8 at every real model width
+    when compute is float32; ~2x from bf16 compute)."""
+    return (kv_bytes_per_token(config, "fp32")
+            / kv_bytes_per_token(config, dtype))
 
 
 class KVBlockPool:
@@ -56,14 +108,19 @@ class KVBlockPool:
     unoccupied table entry can never read or corrupt a live block.
     """
 
-    def __init__(self, n_blocks: int, block_size: int):
+    def __init__(self, n_blocks: int, block_size: int,
+                 dtype: str = "fp32"):
         if n_blocks < 1:
             raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
         if block_size < 1:
             raise ValueError(
                 f"block_size must be >= 1, got {block_size}")
+        if dtype not in KV_DTYPES:
+            raise ValueError(
+                f"unknown KV dtype {dtype!r} (one of {KV_DTYPES})")
         self.n_blocks = n_blocks
         self.block_size = block_size
+        self.dtype = dtype
         self._free: "collections.deque[int]" = collections.deque(
             range(n_blocks))
         self._is_free = [True] * n_blocks
@@ -72,11 +129,24 @@ class KVBlockPool:
         #: a pool (end-of-run used_count has already fallen back to the
         #: cached-prefix residual)
         self.used_peak = 0
+        #: consecutive deferrals (:meth:`record_deferral`) with no
+        #: intervening recovery — the signal /healthz reads as degraded.
+        #: A :meth:`release` that frees ENOUGH blocks to cover the
+        #: deferred need clears it (the pressure is over the moment
+        #: capacity exists, not only at the next successful admission),
+        #: as does the engine on admission.
+        self.deferral_streak = 0
+        #: worst-case blocks the most recent deferral was short — the
+        #: bar a release must clear to end the episode (1 when the
+        #: caller never said: any free block counts)
+        self._deferred_need = 1
         self._closed = False
         self._g_total = GaugeShare(_M_TOTAL)
         self._g_used = GaugeShare(_M_USED)
+        self._g_dtype = GaugeShare(_M_DTYPE.labels(dtype=dtype))
         self._g_total.set(n_blocks)
         self._g_used.set(0)
+        self._g_dtype.set(1)
 
     # -- introspection -------------------------------------------------------
     @property
@@ -143,7 +213,16 @@ class KVBlockPool:
         return zeroed
 
     def release(self, block_ids: Iterable[int]) -> None:
-        """Return refcount-0 blocks to the free list."""
+        """Return refcount-0 blocks to the free list. Freeing enough
+        capacity to cover the deferred need ends the exhaustion
+        episode: the deferral streak resets HERE, so /healthz degraded
+        state self-clears the moment a retiring slot makes the pool
+        healthy again — not only when the next admission succeeds (an
+        idle engine with no queued work would otherwise read degraded
+        forever). A free that does NOT cover the need keeps the streak:
+        a large request starving behind small-block churn must still
+        read degraded and still reach its postmortem trigger."""
+        freed = 0
         for bid in block_ids:
             if self._ref[bid] != 0:
                 raise RuntimeError(
@@ -154,20 +233,35 @@ class KVBlockPool:
                 raise RuntimeError(f"double free of block {bid}")
             self._free.append(bid)
             self._is_free[bid] = True
+            freed += 1
+        if freed and len(self._free) >= self._deferred_need:
+            self.deferral_streak = 0
         self._update_gauges()
 
-    def record_deferral(self) -> None:
+    def record_deferral(self, need: "int | None" = None) -> None:
+        """Count one deferral; ``need`` is the worst-case block count
+        the deferred admission was asking for (sets the recovery bar
+        :meth:`release` must clear)."""
         _M_DEFERRED.inc()
+        self.deferral_streak += 1
+        if need is not None:
+            self._deferred_need = max(1, need)
+
+    def reset_deferral_streak(self) -> None:
+        """An admission succeeded (or the queue drained past the
+        pressure): the exhaustion episode is over."""
+        self.deferral_streak = 0
 
     def _update_gauges(self) -> None:
         used = self.used_count
         if used > self.used_peak:
             self.used_peak = used
         self._g_used.set(used)
-        # re-assert capacity too: a registry().reset() mid-life (test
-        # isolation) zeroes the gauge, and a total that is only pushed
-        # at construction would stay 0 while used recovers
+        # re-assert capacity + dtype too: a registry().reset() mid-life
+        # (test isolation) zeroes the gauges, and values only pushed at
+        # construction would stay 0 while used recovers
         self._g_total.set(0 if self._closed else self.n_blocks)
+        self._g_dtype.set(0 if self._closed else 1)
 
     def close(self) -> None:
         """Retract this pool's gauge contributions (idempotent)."""
@@ -176,3 +270,4 @@ class KVBlockPool:
         self._closed = True
         self._g_total.set(0)
         self._g_used.set(0)
+        self._g_dtype.set(0)

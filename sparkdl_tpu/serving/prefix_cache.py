@@ -148,6 +148,59 @@ class PrefixCache:
             best.stamp = next(self._clock)
         return PrefixMatch(full, partial_id, best_q, i + best_q)
 
+    def suggest(self, tokens: "tuple[int, ...]", k: int) -> "list[int]":
+        """Draft up to ``k`` tokens that FOLLOWED this exact context in
+        a cached prompt — the zero-weight draft source for speculative
+        decoding (ROADMAP item 3): the trie already spells out every
+        prompt it has seen, so when one request's context is a prefix
+        of a cached longer prompt, the cached continuation is a high-
+        probability draft (chat history growing turn by turn, retrieval
+        prompts sharing scaffolding).
+
+        Token ids only — no block references, no refcounts, no stamps
+        touched: drafting must never keep a block alive or perturb LRU
+        order (a wrong draft costs one rejected verify position, not a
+        corrupted cache).
+        """
+        if k < 1:
+            return []
+        bs = self.block_size
+        node = self._root
+        i = 0
+        while len(tokens) - i >= bs:
+            child = node.children.get(tokens[i:i + bs])
+            if child is None:
+                break
+            node = child
+            i += bs
+        rest = tokens[i:]
+        out: "list[int]" = []
+        # descend through the child whose key extends the remainder;
+        # exact-boundary contexts (rest empty) continue down the most
+        # recently used child path
+        while len(out) < k:
+            step = None
+            best_stamp = -1
+            for key, child in node.children.items():
+                if key[:len(rest)] == rest and child.stamp > best_stamp:
+                    step, best_stamp = child, child.stamp
+            if step is not None:
+                out.extend(step.key[len(rest):])
+                node, rest = step, ()
+                continue
+            # no full-block continuation: the freshest partial tail
+            # extending the remainder ends the walk
+            best = None
+            for p in node.partials:
+                if (len(p.tokens) > len(rest)
+                        and p.tokens[:len(rest)] == rest
+                        and (best is None or p.stamp > best.stamp)):
+                    best = p
+            if best is not None:
+                out.extend(best.tokens[len(rest):])
+            break
+        return out[:k]
+
     def record_lookup(self, hit_tokens: int, miss_tokens: int) -> None:
         """Land one admission's hit/miss split (prompt tokens) in the
         spine + the engine-local counters."""

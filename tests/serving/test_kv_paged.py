@@ -244,14 +244,14 @@ def test_healthz_degraded_on_exhaustion_streak(bundle):
     eng.tick()
     fb = eng.submit([1, 4], 4)
     eng.tick()  # defer: streak begins
-    assert eng._defer_streak >= 1
+    assert eng._pool.deferral_streak >= 1
     report = healthz_report()
     assert report["status"] == "degraded", report
     mine = [p for p in report["kv_pools"]
             if p["exhausted_streak"]]
     assert mine and mine[0]["blocks_total"] == 2
     _drain(eng, [fa, fb])  # A retires -> B admits -> streak clears
-    assert eng._defer_streak == 0
+    assert eng._pool.deferral_streak == 0
     assert healthz_report()["status"] in ("ok", "degraded")
     assert not [p for p in healthz_report()["kv_pools"]
                 if p["exhausted_streak"]]
