@@ -55,10 +55,22 @@ and the speedup; and the same workload over a `BENCH_KV_DTYPE`
 `capacity_ratio_vs_fp32` (asserted >= 2 for int8: the same pool bytes
 hold 2x+ the live tokens) and the `token_agreement_vs_fp32` parity
 delta the compression trades.
+
+Sequence-parallel long-context section (ISSUE 13): the same long
+prompt (`BENCH_LONG_PROMPT_LEN`=3072) prefilled at sp=1 vs
+sp=`BENCH_SP` (default 2; <2 disables) over forced CPU devices,
+spatial chunks of `BENCH_SP_CHUNK`=1024 tokens, medians of 3 with
+FRESH prompts per round (a repeated prompt would prefix-hit and
+measure a no-op). Emits `sp_axis`, `prefill_shard_tokens`,
+`sp_prefill_speedup` and the `sp_prefill` block; greedy tokens must
+stay bitwise across sp. Keep the prompt long: below ~1k tokens the
+per-chunk fixed costs beat the q-split and sp measures a LOSS
+(PERF.md).
 """
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -214,6 +226,95 @@ def _gpt_paged_section():
     }
 
 
+def _gpt_sp_section():
+    """Long-context prefill: the SAME long prompt prefilled through the
+    continuous engine at sp=1 vs sp=BENCH_SP (sequence-parallel spatial
+    chunks over forced CPU devices), medians of 3 (CPU numbers are
+    bimodal — PERF.md). Greedy tokens must stay bitwise; the headline
+    is prefill seconds and the sp speedup. None when BENCH_SP < 2."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+    from sparkdl_tpu.serving import ContinuousGPTEngine
+
+    sp = int(os.environ.get("BENCH_SP", "2"))
+    if sp < 2:
+        return None
+    if len(jax.devices()) < sp:
+        # An ambient XLA_FLAGS device pin below sp (main() never
+        # overrides a caller's pin) must not kill the whole bench —
+        # the driver contract is ONE JSON line no matter what. Skip
+        # the section; sp fields ride as None.
+        print(
+            f"bench_serving: skipping sp section (BENCH_SP={sp} needs "
+            f"{sp} devices, have {len(jax.devices())}; force them with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count)",
+            file=sys.stderr)
+        return None
+    plen = int(os.environ.get("BENCH_LONG_PROMPT_LEN", "3072"))
+    n_req = int(os.environ.get("BENCH_SP_REQUESTS", "1"))
+    max_new = 4  # prefill-dominated on purpose: decode is not the story
+    max_len = plen + max_new
+    # GENUINELY long context: the q-split only beats the per-chunk
+    # fixed costs (staged-head gather, scatter, collectives) once the
+    # O(L^2) score block dominates — at 768 tokens sp=2 measured
+    # 0.85-0.95x (a LOSS; PERF.md), at 3072 it wins 2.3x. Keep the
+    # prompt long and the chunks wide when studying sp.
+    cfg = GPTConfig(
+        vocab_size=512, hidden_size=256, num_layers=4, num_heads=8,
+        intermediate_size=512, max_seq_len=4 * max_len,
+    )
+    model = GPTLMHeadModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(2), jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(17)
+    # fresh prompts per measurement round: a repeated prompt would
+    # full-prompt-HIT the prefix cache and measure a no-op prefill
+    rounds = [[rng.integers(1, cfg.vocab_size, plen).tolist()
+               for _ in range(n_req)] for _ in range(3)]
+    warm = rng.integers(1, cfg.vocab_size, plen).tolist()
+    chunk = int(os.environ.get("BENCH_SP_CHUNK", "1024"))
+
+    def run(sp_axis):
+        eng = ContinuousGPTEngine(
+            cfg, variables, n_slots=2, max_len=max_len,
+            kv_block_size=32, prefill_chunk=chunk,
+            sp=(None if sp_axis < 2 else sp_axis),
+            idle_wait_s=0.0005,
+        )
+        eng.submit(warm, 2).result(timeout=600)  # compile warmup
+        walls, outs = [], []
+        for prompts in rounds:  # medians of 3: CPU numbers are bimodal
+            snap0 = eng.snapshot()
+            futs = [eng.submit(p, max_new) for p in prompts]
+            outs.extend(np.asarray(f.result(timeout=600)) for f in futs)
+            walls.append(eng.snapshot()["prefill_seconds"]
+                         - snap0["prefill_seconds"])
+        eng.close()
+        return outs, float(np.median(walls))
+
+    outs1, pf1 = run(1)
+    outs_sp, pf_sp = run(sp)
+    bitwise = all(np.array_equal(a, b) for a, b in zip(outs1, outs_sp))
+    return {
+        "sp_axis": sp,
+        "prompt_len": plen,
+        "requests": n_req,
+        "prefill_chunk": chunk,
+        # tokens of each chunk one chip holds under sp (the shard grain)
+        "prefill_shard_tokens": min(chunk, plen) // sp,
+        "sp1_prefill_seconds": round(pf1, 4),
+        "sp_prefill_seconds": round(pf_sp, 4),
+        "sp_prefill_speedup": round(pf1 / pf_sp, 4) if pf_sp else None,
+        "prefill_tokens_per_s_sp1":
+            round(n_req * plen / pf1, 1) if pf1 else None,
+        "prefill_tokens_per_s_sp":
+            round(n_req * plen / pf_sp, 1) if pf_sp else None,
+        "sp_bitwise_vs_sp1": bitwise,
+    }
+
+
 def _gpt_spec_section():
     """Decode-heavy workload: speculative verify (spec_k) vs plain k=1,
     then a quantized pool vs fp32 — the two raw per-request speed/memory
@@ -333,14 +434,16 @@ def _gpt_spec_section():
 
 def main() -> None:
     n_replicas = int(os.environ.get("BENCH_REPLICAS", "1"))
-    if (n_replicas > 1
+    n_sp = int(os.environ.get("BENCH_SP", "2"))
+    n_dev = max(n_replicas, n_sp)
+    if (n_dev > 1
             and "xla_force_host_platform_device_count"
             not in os.environ.get("XLA_FLAGS", "")):
-        # simulated replicas on the CPU harness: one virtual device per
-        # replica, fixed before jax's first import
+        # simulated replicas / sp chips on the CPU harness: one virtual
+        # device per chip, fixed before jax's first import
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={n_replicas}"
+            + f" --xla_force_host_platform_device_count={n_dev}"
         ).strip()
     import jax
 
@@ -461,6 +564,11 @@ def main() -> None:
     # workload, spec_k vs k=1 (bitwise) and int8 vs fp32 pools.
     spec = _gpt_spec_section()
 
+    # Sequence-parallel long-context prefill (ISSUE 13): the same long
+    # prompt at sp=1 vs sp=BENCH_SP, spatial chunks over forced CPU
+    # devices, medians of 3.
+    sp_prefill = _gpt_sp_section()
+
     gap = calibrate_dispatch_gap()
     n_dispatches = dispatch_count("serving")
     snap_wall = registry().snapshot().get(
@@ -505,6 +613,14 @@ def main() -> None:
         "kv_capacity_ratio": (spec or {}).get("kv_quant", {}).get(
             "capacity_ratio_vs_fp32"),
         "spec_decode": spec,
+        # Sequence parallelism (ISSUE 13): long-context prefill split
+        # across sp chips (None when BENCH_SP<2)
+        "sp_axis": (sp_prefill or {}).get("sp_axis"),
+        "prefill_shard_tokens": (sp_prefill or {}).get(
+            "prefill_shard_tokens"),
+        "sp_prefill_speedup": (sp_prefill or {}).get(
+            "sp_prefill_speedup"),
+        "sp_prefill": sp_prefill,
         # SLO accounting + flight recorder (ISSUE 9): declared objective
         # with rolling burn, and the event-ring volume this run produced
         "slo": replica_snap.get("slo"),

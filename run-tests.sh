@@ -149,6 +149,66 @@ assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys(), rec
 assert "over 8 devices" in rec["metric"], rec
 print("bench.py dp contract OK")
 '
+# Sequence-parallel smoke (ISSUE 13): 2 forced CPU devices, sp=2
+# spatial prefill vs sp=1 — greedy tokens bitwise on a prompt spanning
+# >= 3 chunks, the prefix-cache hit preserved across the sharded
+# gather, and the sp.permute/sp.gather chaos contract: an injected
+# collective fault mid-prefill re-queues the victim (typed flight
+# event, zero lost admitted requests, still bitwise).
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+SPARKDL_TPU_FAULT_PLAN="sp.permute:OSError@2;sp.gather:OSError@2" \
+python - <<'EOF'
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+from sparkdl_tpu.observability.flight import flight_recorder
+from sparkdl_tpu.serving import ContinuousGPTEngine
+
+cfg = GPTConfig.tiny()
+model = GPTLMHeadModel(cfg)
+variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+rng = np.random.default_rng(9)
+shared = rng.integers(1, cfg.vocab_size, 10).tolist()
+cases = [
+    (list(rng.integers(1, cfg.vocab_size, 19)), 5),  # >= 3 chunks at 8
+    (shared + rng.integers(1, cfg.vocab_size, 3).tolist(), 5),
+    (shared + rng.integers(1, cfg.vocab_size, 2).tolist(), 4),  # hit
+]
+
+def run(sp):
+    eng = ContinuousGPTEngine(
+        cfg, variables, n_slots=2, max_len=64, kv_block_size=4,
+        prefill_chunk=8, sp=(None if sp < 2 else sp), auto_start=False)
+    futs = [eng.submit(p, n) for p, n in cases]
+    for _ in range(500):
+        eng.tick()
+        if all(f.done() for f in futs):
+            break
+    outs = [np.asarray(f.result(timeout=0)) for f in futs]
+    snap = eng.snapshot()
+    eng.close()
+    return outs, snap
+
+outs1, _ = run(1)            # fault plan hits 1: sp sites never fire
+outs2, snap2 = run(2)        # hits 2: one permute + one gather injected
+assert all(np.array_equal(a, b) for a, b in zip(outs1, outs2)), \
+    "sp=2 diverged from sp=1"
+kv = snap2["kv"]
+assert kv["prefix_hits"] > 0, kv       # hit survived the sharded gather
+assert kv["sp"]["axis"] == 2, kv
+assert kv["sp"]["handoffs"] >= len(cases), kv
+assert kv["sp"]["staging_blocks_used"] == 0, kv  # all staging released
+evs = [e for e in flight_recorder().events()
+       if e.get("kind") == "sp.collective_failed"]
+sites = {e["site"] for e in evs}
+assert {"sp.permute", "sp.gather"} <= sites, sites
+assert all(e["error"] == "SpCollectiveError" for e in evs), evs
+print(f"sp smoke OK: sp=2 bitwise vs sp=1 across {len(cases)} requests "
+      f"(3-chunk prompt, prefix hit {kv['prefix_hits']} tokens), "
+      f"injected {sorted(sites)} faults -> re-queued, zero lost")
+EOF
+
 # Online serving bench: same one-JSON-line contract; vs_baseline is the
 # micro-batch / batch-of-1 throughput ratio under open-loop Poisson load.
 # BENCH_SPEC_K/BENCH_KV_DTYPE are pinned: the contract below asserts the
@@ -202,8 +262,19 @@ assert 0 <= sd["kv_quant"]["token_agreement_vs_fp32"] <= 1, sd
 assert "sparkdl_spec_proposed_total" in obs, sorted(obs)
 assert "sparkdl_spec_accepted_total" in obs, sorted(obs)
 assert "sparkdl_kv_pool_dtype" in obs, sorted(obs)
+# ISSUE 13: sequence-parallel long-context prefill — sp axis, shard
+# grain, measured speedup (the acceptance bar: sp=2 prefill seconds
+# <= 0.75x sp=1, i.e. speedup >= 1.333), bitwise verdict, sp metrics
+spf = rec["sp_prefill"]
+assert rec["sp_axis"] == 2, rec["sp_axis"]
+assert rec["prefill_shard_tokens"] > 0, rec
+assert spf["sp_bitwise_vs_sp1"] is True, spf
+assert rec["sp_prefill_speedup"] >= 1.333, spf
+assert "sparkdl_sp_ring_steps_total" in obs, sorted(obs)
+assert "sparkdl_sp_permute_bytes_total" in obs, sorted(obs)
+assert "sparkdl_sp_shard_imbalance" in obs, sorted(obs)
 print("bench_serving contract OK (snapshot + slo + flight + kv + spec "
-      "embedded)")
+      "+ sp embedded)")
 '
 
 # Paged-KV smoke (ISSUE 10): (a) a shared-prefix workload through the

@@ -70,12 +70,30 @@ class GPTConfig:
     #: masking) for shapes where streaming wins.
     flash_decode: bool = False
     sp_axis: str = "sp"
+    #: collective schedule for ``attn_impl='ring'``: "ring" rotates K/V
+    #: shards via ppermute with an online softmax (O(L/sp) resident
+    #: keys, exact up to fp accumulation order); "allgather" gathers the
+    #: K/V shards once and runs the dense masked softmax per query shard
+    #: — BITWISE-identical to the single-device full path, the right
+    #: choice at small sp where the gathered keys fit (serving uses it
+    #: for the sp∈{1,2} prefill parity contract).
+    sp_mode: str = "ring"
     #: 0 = dense MLPs; >0 = MoE with this many experts
     num_experts: int = 0
     moe_every: int = 2  #: every Nth block is MoE (when num_experts > 0)
     moe_k: int = 2
     moe_capacity_factor: float = 2.0
     dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        # Loud at construction: a typo'd sp_mode would otherwise fall
+        # through to the ring schedule and silently trade away the
+        # allgather path's bitwise-parity guarantee.
+        if self.sp_mode not in ("ring", "allgather"):
+            raise ValueError(
+                f"unknown sp_mode {self.sp_mode!r}: expected 'ring' or "
+                "'allgather'"
+            )
 
     @classmethod
     def tiny(cls, **kw) -> "GPTConfig":
@@ -202,7 +220,8 @@ class GPTAttention(nn.Module):
     @nn.compact
     def __call__(self, x, *, cache: Optional[dict], train: bool,
                  positions: Optional[jax.Array] = None,
-                 attention_mask: Optional[jax.Array] = None):
+                 attention_mask: Optional[jax.Array] = None,
+                 return_kv: bool = False):
         c = self.config
         h, nh = c.hidden_size, c.num_heads
         hd = h // nh
@@ -319,7 +338,12 @@ class GPTAttention(nn.Module):
                 p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
                 ctx = jnp.einsum("bhqk,bkhd->bqhd", p, cv)
         else:
-            new_entry = None
+            # return_kv: hand the (post-rope) K/V of this uncached
+            # forward to the caller — the prefill half of sequence
+            # parallelism (sp_prefill): each sp shard's K/V row feeds
+            # the serving cache without a second projection pass.
+            new_entry = (k.astype(c.dtype), v.astype(c.dtype)) \
+                if return_kv else None
             if attention_mask is not None and c.attn_impl != "full":
                 raise ValueError(
                     "attention_mask on the uncached forward requires "
@@ -331,6 +355,14 @@ class GPTAttention(nn.Module):
                 from sparkdl_tpu.ops.flash_attention import flash_attention
 
                 ctx = flash_attention(q, k, v, causal=True)
+            elif c.attn_impl == "ring" and c.sp_mode == "allgather":
+                from sparkdl_tpu.parallel.ring_attention import (
+                    allgather_self_attention,
+                )
+
+                ctx = allgather_self_attention(
+                    q, k, v, axis_name=c.sp_axis, causal=True
+                )
             elif c.attn_impl == "ring":
                 ctx = ring_self_attention(
                     q, k, v, axis_name=c.sp_axis, causal=True
@@ -361,13 +393,14 @@ class GPTBlock(nn.Module):
     @nn.compact
     def __call__(self, x, *, cache: Optional[dict], train: bool,
                  positions: Optional[jax.Array] = None,
-                 attention_mask: Optional[jax.Array] = None):
+                 attention_mask: Optional[jax.Array] = None,
+                 return_kv: bool = False):
         c = self.config
         a, new_entry = GPTAttention(c, self.layer_idx, name="attn")(
             nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
                          name="ln_1")(x),
             cache=cache, train=train, positions=positions,
-            attention_mask=attention_mask,
+            attention_mask=attention_mask, return_kv=return_kv,
         )
         x = x + nn.Dropout(c.dropout, deterministic=not train)(a)
 
@@ -425,7 +458,8 @@ class GPTLMHeadModel(nn.Module):
     def __call__(self, input_ids, *, cache: Optional[dict] = None,
                  train: bool = False,
                  positions: Optional[jax.Array] = None,
-                 attention_mask: Optional[jax.Array] = None):
+                 attention_mask: Optional[jax.Array] = None,
+                 return_kv: bool = False):
         c = self.config
         wte = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
                        name="wte")
@@ -446,7 +480,7 @@ class GPTLMHeadModel(nn.Module):
         for i in range(c.num_layers):
             x, entry = GPTBlock(c, i, name=f"h_{i}")(
                 x, cache=cache, train=train, positions=positions,
-                attention_mask=attention_mask,
+                attention_mask=attention_mask, return_kv=return_kv,
             )
             if entry is not None:
                 new_ks.append(entry[0])
@@ -461,6 +495,16 @@ class GPTLMHeadModel(nn.Module):
                 "k": jnp.stack(new_ks),
                 "v": jnp.stack(new_vs),
                 "idx": cache["idx"] + input_ids.shape[1],
+            }
+        elif return_kv:
+            # uncached KV-returning forward (the sp prefill building
+            # block): k/v stacked over layers for THIS call's tokens —
+            # under shard_map, the caller's local shard; ``idx`` is the
+            # local token count (a global prefill offsets it itself)
+            cache = {
+                "k": jnp.stack(new_ks),
+                "v": jnp.stack(new_vs),
+                "idx": jnp.asarray(input_ids.shape[1], jnp.int32),
             }
         return logits, cache
 
@@ -713,3 +757,75 @@ def generate(
         step, (cache, tok, rng), None, length=max_new_tokens
     )
     return jnp.concatenate([prompt_ids, toks.swapaxes(0, 1)], axis=1)
+
+
+def sp_prefill(
+    model: GPTLMHeadModel,
+    variables: Any,
+    prompt_ids: jax.Array,
+    mesh: Any,
+) -> "tuple[jax.Array, dict]":
+    """Sequence-parallel prompt prefill: shard the TOKENS of one (long)
+    prompt contiguously across the mesh's ``sp`` chips and run ONE
+    forward in which every chip computes its token shard's Q/K/V and
+    attention follows ``config.sp_mode``:
+
+    - ``"ring"`` — K/V shards rotate around the ring via ``ppermute``
+      (:func:`~sparkdl_tpu.parallel.ring_attention.ring_self_attention`),
+      each hop folding the visiting block into an online softmax with
+      causal masking per (query-shard, key-shard) offset pair. O(L/sp)
+      resident keys per chip — the long-context schedule. Exact up to
+      fp accumulation order.
+    - ``"allgather"`` — gather the K/V shards once, dense masked
+      softmax per query shard: **bitwise-identical** logits to the
+      unsharded forward (the serving parity contract), right for small
+      ``sp`` where the gathered keys fit.
+
+    Requires ``config.attn_impl == "ring"``. Prompts whose length does
+    not divide ``sp`` are right-padded internally (pad keys sit causally
+    AFTER every real query, so they are invisible without a mask) and
+    the pad positions sliced off the outputs. Returns
+    ``(logits [B, L, V], cache)`` where ``cache`` is an
+    :func:`init_cache`-shaped pytree holding the prompt's K/V (k/v
+    ``[layers, B, L, H, D]``, ``idx = L``) — ready to seed decode.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from sparkdl_tpu.compat import shard_map
+
+    c = model.config
+    axis = c.sp_axis
+    if c.attn_impl != "ring":
+        raise ValueError(
+            f"sp_prefill requires attn_impl='ring' (sp_mode="
+            f"'ring'|'allgather'), got attn_impl={c.attn_impl!r}"
+        )
+    sp = int(mesh.shape[axis])
+    b, l = prompt_ids.shape
+    pad = (-l) % sp
+    lpad = l + pad
+    if c.positions == "learned" and lpad > c.max_seq_len:
+        raise ValueError(
+            f"prompt_len {l} (padded to {lpad} for sp={sp}) exceeds the "
+            f"learned position table (max_seq_len={c.max_seq_len})"
+        )
+    ids = jnp.pad(jnp.asarray(prompt_ids, jnp.int32), ((0, 0), (0, pad)))
+    # GLOBAL positions per shard — the ring kernel offsets its causal
+    # mask globally and RoPE must agree with it (model docstring)
+    positions = jnp.broadcast_to(jnp.arange(lpad)[None, :], (b, lpad))
+
+    def local(variables, ids_l, pos_l):
+        logits, kv = model.apply(
+            variables, ids_l, positions=pos_l, return_kv=True)
+        return logits, kv["k"], kv["v"]
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P(None, axis), P(None, axis)),
+        out_specs=(P(None, axis), P(None, None, axis),
+                   P(None, None, axis)),
+    )
+    logits, ks, vs = fn(variables, ids, positions)
+    cache = {"k": ks[:, :, :l], "v": vs[:, :, :l],
+             "idx": jnp.asarray(l, jnp.int32)}
+    return logits[:, :l], cache

@@ -150,6 +150,50 @@ def ring_self_attention(
     )
 
 
+def allgather_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: jax.Array | None = None,
+    *,
+    axis_name: str = "sp",
+    causal: bool = False,
+) -> jax.Array:
+    """All-gather attention on already-local [B, L/sp, H, D] blocks.
+
+    The small-``sp`` alternative to the ring schedule: gather every
+    peer's K/V once (one tiled all-gather riding ICI) and run the dense
+    masked softmax for the LOCAL query shard over the FULL key sequence
+    — scale by division, mask with the global causal offsets, softmax
+    over the whole row at once. Because each query row's math is then
+    EXACTLY the single-device full-attention computation (no online
+    max/denominator re-association), the result is **bitwise-identical**
+    to unsharded attention — the property the serving prefill's parity
+    contract rides. Memory is O(L) gathered keys per chip (vs the
+    ring's O(L/sp)), which is why the ring stays the long-context /
+    large-``sp`` schedule.
+    """
+    import math
+
+    b, lq, h, d = q.shape
+    kg = lax.all_gather(k, axis_name, axis=1, tiled=True)  # [B, L, H, D]
+    vg = lax.all_gather(v, axis_name, axis=1, tiled=True)
+    my_idx = lax.axis_index(axis_name)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, kg, preferred_element_type=jnp.float32,
+    ) / math.sqrt(d)
+    if causal:
+        q_pos = my_idx * lq + jnp.arange(lq)
+        k_pos = jnp.arange(kg.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    if kv_mask is not None:
+        mg = lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
+        s = jnp.where(mg[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, vg)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,

@@ -34,6 +34,7 @@ def make_mesh(*, dp: int = -1, pp: int = 1, fsdp: int = 1, sp: int = 1,
 
     >>> make_mesh(dp=4, fsdp=2)          # 8 devices: 4-way dp, 2-way zero
     >>> make_mesh(tp=4)                  # dp inferred = n_devices // 4
+    >>> make_mesh(dp=1, sp=2)            # sequence-parallel prefill pair
 
     Every axis is always present (size-1 axes are inert), so
     ``PartitionSpec``\\ s naming any canonical axis resolve on any mesh

@@ -48,6 +48,8 @@ __all__ = [
     "BERT_RULES",
     "VIT_RULES",
     "GENERIC_RULES",
+    "KV_POOL_RULES",
+    "sequence_activation_spec",
     "default_rules_for",
 ]
 
@@ -173,6 +175,42 @@ GENERIC_RULES: "tuple[tuple[str, P], ...]" = (
     (r"kernel$", P("fsdp", None)),
     (r".*", P()),
 )
+
+#: Sequence-axis placement for the paged KV BLOCK POOL (ISSUE 13):
+#: matched over an ``init_block_pool`` tree, the k/v pool arrays
+#: ``[layers, n_blocks, block_size, H, D]`` (and the int8 per-column
+#: scale arrays ``[layers, n_blocks, block_size]``) shard their BLOCK
+#: axis on ``sp`` — contiguous shards, so virtual block id ``b`` lives
+#: on chip ``b // (n_blocks/sp)`` (the mapping
+#: ``serving.kv_blocks.SeqShardedBlockPool`` mirrors host-side). ``sp``
+#: shards *tokens*, never weights: params stay on the replicated /
+#: tp-sharded tables above.
+KV_POOL_RULES: "tuple[tuple[str, P], ...]" = (
+    (r"(^|/)(k|v)$", P(None, "sp")),
+    (r"_scale$", P(None, "sp")),
+    (r".*", P()),
+)
+
+
+def sequence_activation_spec(*, ndim: int, seq_dim: int = 1,
+                             sp_axis: str = "sp",
+                             batch_axes: "Sequence[str]" = ()) -> P:
+    """``PartitionSpec`` placing an activation's SEQUENCE dim on the
+    ``sp`` mesh axis (and optionally its batch dim on ``batch_axes``) —
+    the placement vocabulary for sequence-parallel prefill: token ids
+    ``[B, L]`` (``ndim=2``), logits ``[B, L, V]`` (``ndim=3``), or
+    per-layer K/V ``[layers, B, L, H, D]`` (``ndim=5, seq_dim=2``).
+    Contiguous token shards: chip ``c`` holds columns
+    ``[c*L/sp, (c+1)*L/sp)``, the layout the ring/all-gather causal
+    masks assume."""
+    if not 0 <= seq_dim < ndim:
+        raise ValueError(
+            f"seq_dim {seq_dim} out of range for ndim {ndim}")
+    parts: "list" = [None] * ndim
+    if batch_axes:
+        parts[0] = tuple(batch_axes)
+    parts[seq_dim] = sp_axis
+    return P(*parts)
 
 _TABLES = {
     "gpt": GPT_RULES,

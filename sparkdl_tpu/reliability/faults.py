@@ -75,6 +75,8 @@ KNOWN_SITES = (
     "kv.alloc",
     "kv.quantize",
     "spec.verify",
+    "sp.permute",
+    "sp.gather",
     "worker.rank",
 )
 

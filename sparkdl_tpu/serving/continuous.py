@@ -69,6 +69,24 @@ capacity (and deferred-admission pressure) improves 2-4x
 (:func:`~sparkdl_tpu.serving.kv_blocks.kv_capacity_ratio`) while
 compute still runs at the model dtype; bench_serving's dense-vs-paged
 parity harness measures the quality trade.
+
+Sequence-parallel prefill (``sp=``, ROADMAP item 2): with ``sp=N``
+the chunked prefill becomes SPATIAL — each chunk dispatches across N
+chips (queries sharded on the ``sp`` mesh axis, K/V all-gathered for
+the causal attention) and the accumulating prompt K/V lives in a
+sequence-sharded staging pool
+(:class:`~sparkdl_tpu.serving.kv_blocks.SeqShardedBlockPool`), so a
+long context never has to fit one chip during prefill. ONE gather at
+the prefill→decode handoff (``sp.gather`` fault site) installs the
+staged K/V into the decode pool; the per-token loop — plain, chained,
+speculative — is the untouched single-device paged path, which is why
+greedy tokens stay bitwise across sp∈{1,2} on every decode mode. An
+injected collective fault (``sp.permute``/``sp.gather``) re-queues the
+victim request instead of failing it (:class:`SpCollectiveError` in
+the flight ring). README "Long-context serving" has the sizing
+arithmetic; PERF.md the measured trade (sp=2 prefill 2.26x at 3072
+prompt tokens on the CPU harness — and a measured LOSS below ~1k
+tokens, where the per-chunk fixed costs beat the query split).
 """
 
 from __future__ import annotations
@@ -107,6 +125,25 @@ from sparkdl_tpu.serving.queue import (
 _M_PREFILL_CHUNKS = registry().counter(
     "sparkdl_prefill_chunks_total",
     "bounded prefill chunks dispatched by continuous GPT engines")
+
+_M_SP_RING_STEPS = registry().counter(
+    "sparkdl_sp_ring_steps_total",
+    "collective hops dispatched by sequence-parallel prefill chunks "
+    "(sp - 1 per sharded chunk dispatch)")
+_M_SP_PERMUTE_BYTES = registry().counter(
+    "sparkdl_sp_permute_bytes_total",
+    "estimated K/V bytes moved between sp chips by prefill collectives "
+    "(2 x layers x chunk_width x hidden x itemsize x (sp-1) per "
+    "dispatch)")
+
+
+class SpCollectiveError(RuntimeError):
+    """A sequence-parallel collective (ring permute hop or the
+    prefill→decode handoff gather) failed. The engine never surfaces
+    this to a caller: the victim request's prefill is torn down, its
+    blocks released, and the request RE-QUEUED at the head — an
+    already-admitted request is never lost to a collective fault (the
+    ``sp.permute`` / ``sp.gather`` chaos contract)."""
 
 _M_SPEC_PROPOSED = registry().counter(
     "sparkdl_spec_proposed_total",
@@ -183,6 +220,11 @@ class _Prefill:
     ck: Any = None  # None until the first (gather-fused) chunk ran
     cv: Any = None
     chunks: int = 0
+    #: sequence-parallel staging blocks (sp > 1): the prompt's K/V
+    #: accumulate in these SeqShardedBlockPool blocks — sharded across
+    #: the sp chips — instead of the private dense cache, until the
+    #: prefill→decode handoff gathers them once
+    sp_blocks: "list[int] | None" = None
 
     def all_blocks(self) -> "list[int]":
         """Every pool reference this prefill holds (release on abort)."""
@@ -226,6 +268,13 @@ class ContinuousGPTEngine:
     None = auto-calibrate from the dispatch gap; 1 (default) = one
     token per dispatch, the exact pre-chaining tick semantics.
 
+    ``sp`` (paged layout; pin via ``SPARKDL_TPU_SP``) spreads each
+    prefill chunk across that many chips and stages the prompt's K/V
+    in a sequence-sharded pool (``sp_kv_blocks`` sizes it; default =
+    the decode pool rounded up to divide ``sp``). Power of two, at
+    most the visible device count. Decode is untouched: one handoff
+    gather per admission. None/1 (default) = off.
+
     ``spec_k`` (paged layout) turns on speculative decoding: up to
     ``spec_k - 1`` draft tokens per slot (from ``draft_source``,
     default radix-trie + n-gram — :mod:`serving.spec_decode`) are
@@ -247,6 +296,8 @@ class ContinuousGPTEngine:
                  kv_block_size: int = 16,
                  kv_blocks: "int | None" = None,
                  prefill_chunk: "int | None" = None,
+                 sp: "int | None" = None,
+                 sp_kv_blocks: "int | None" = None,
                  spec_k: "int | None" = None,
                  draft_source: Any = None,
                  kv_dtype: str = "fp32",
@@ -286,6 +337,20 @@ class ContinuousGPTEngine:
                 "(kv_dtype) require kv_layout='paged'; the dense layout "
                 "is the exact parity oracle"
             )
+        if sp is not None and sp < 1:
+            raise ValueError(f"sp must be >= 1, got {sp}")
+        # Resolve the env pin HERE, before layout validation, so
+        # SPARKDL_TPU_SP=2 on a dense-layout engine raises exactly like
+        # sp=2 the argument would (pins are loud — a silently non-sp
+        # engine is the failure mode resolve_pin exists to prevent).
+        from sparkdl_tpu.ingest.pipeline import resolve_pin
+        sp_val, _, _ = resolve_pin(sp, "SPARKDL_TPU_SP", 1, what="sp")
+        if kv_layout != "paged" and sp_val > 1:
+            raise ValueError(
+                "sequence parallelism (sp) requires kv_layout='paged': "
+                "the sp prefill stages K/V in a sequence-sharded block "
+                "pool"
+            )
         if (config.positions == "learned"
                 and max_len > config.max_seq_len):
             raise ValueError(
@@ -302,6 +367,8 @@ class ContinuousGPTEngine:
         self.kv_layout = kv_layout
         self.spec_k = spec_k
         self.kv_dtype = kv_dtype if kv_layout == "paged" else "fp32"
+        self.sp = 1  # raised past 1 by _init_sp in the paged branch
+        self._sp_handoffs = 0
         self._spec_policy = (SpecPolicy(max_k=spec_k)
                              if spec_k is not None else None)
         self._spec_dispatches = 0
@@ -335,7 +402,6 @@ class ContinuousGPTEngine:
         model = self._model
 
         if kv_layout == "paged":
-            from sparkdl_tpu.ingest.pipeline import resolve_pin
             from sparkdl_tpu.models.gpt import dequantize_kv, quantize_kv
             from sparkdl_tpu.serving.kv_blocks import KVBlockPool
             from sparkdl_tpu.serving.prefix_cache import PrefixCache
@@ -391,6 +457,9 @@ class ContinuousGPTEngine:
                 # below, EngineObservability last)
                 fault_point("kv.quantize")
             self._pool = KVBlockPool(kv_blocks, bs_kv, dtype=kv_dtype)
+            #: which pool the last deferral was short on (_defer reads
+            #: it; the sp staging branch points it at _sp_pool)
+            self._defer_pool = self._pool
             self._prefix = PrefixCache(self._pool)
             self._draft = (draft_source if draft_source is not None
                            else default_draft_source(self._prefix))
@@ -625,6 +694,11 @@ class ContinuousGPTEngine:
             self._chunk_first_fn = _chunk_first
             self._chunk_mid_fn = _chunk_mid
             self._chunk_final_fn = _chunk_final
+            # the sp handoff/prefix programs reuse the dtype boundary
+            self._dq_gather_fn = _dq_gather
+            self._q_write_fn = _q_write
+            if sp_val > 1:
+                self._init_sp(sp_val, sp_kv_blocks)
         else:
             self._cache = init_cache(
                 config, n_slots, max_len, per_slot=True)
@@ -717,6 +791,181 @@ class ContinuousGPTEngine:
         if auto_start:
             self.start()
 
+    # -- sequence-parallel prefill (ISSUE 13 / ROADMAP item 2) ---------------
+    def _init_sp(self, sp: int, sp_kv_blocks: "int | None") -> None:
+        """Spatial prefill chunks: a dp=1 mesh over the first ``sp``
+        local devices, a sequence-sharded STAGING pool (block axis on
+        the ``sp`` mesh axis, placed through the partitioner's
+        ``KV_POOL_RULES``), and explicit-sharding chunk programs whose
+        QUERIES are sharded over ``sp`` — each chip embeds and projects
+        its contiguous token shard, GSPMD all-gathers the chunk's K/V
+        for the causal attention (the all-gather schedule of
+        ``models.gpt.sp_prefill``; the ring rotation is the large-sp /
+        on-chip variant), so one tick's chunk runs across ``sp`` chips
+        instead of one. The staging pool holds the accumulating prompt
+        K/V between ticks (sharded — a long context never has to fit
+        one chip); decode stays on the untouched single-device paged
+        path, fed by ONE gather at the prefill→decode handoff
+        (``sp.gather`` fault site).
+
+        Staging stores the COMPUTE dtype even under quantized decode
+        pools: chunks then attend over exact K/V (bitwise-identical to
+        the sp=1 private-cache path) and the handoff install quantizes
+        ONCE — exactly where the single-device install does.
+        """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from sparkdl_tpu.models.gpt import init_block_pool
+        from sparkdl_tpu.partition.mesh_factory import make_mesh
+        from sparkdl_tpu.partition.rules import (
+            KV_POOL_RULES,
+            match_partition_rules,
+            sequence_activation_spec,
+        )
+        from sparkdl_tpu.serving.kv_blocks import SeqShardedBlockPool
+
+        if sp & (sp - 1):
+            raise ValueError(
+                f"sp must be a power of two (chunk widths bucket to "
+                f"powers of two and shard evenly), got {sp}")
+        devs = jax.devices()
+        if sp > len(devs):
+            raise ValueError(
+                f"sp={sp} exceeds the {len(devs)} visible devices")
+        self.sp = sp
+        # every chunk-program width (pow2_bucket clamped to _chunk_cap)
+        # must SHARD EVENLY over sp — a non-divisible cap (prefill_chunk
+        # not a multiple of sp, or an odd table span) would crash the
+        # first full-width dispatch on the ids in_sharding. Floor the
+        # cap to a multiple of sp (never below sp) and clamp the
+        # per-tick budget under it (a tick must never stage more real
+        # tokens than one program can carry).
+        self._chunk_cap = max(sp, (self._chunk_cap // sp) * sp)
+        self.prefill_chunk = min(self.prefill_chunk, self._chunk_cap)
+        config = self.config
+        model = self._model
+        bs_kv = self._kv_bs
+        n_layers, nh = config.num_layers, config.num_heads
+        hd = config.hidden_size // nh
+        max_pos = (config.max_seq_len - 1
+                   if config.positions == "learned"
+                   else self._wp + self.prefill_chunk)
+        mesh = make_mesh(dp=1, sp=sp, devices=devs[:sp])
+        self._sp_mesh = mesh
+        n_sp = (sp_kv_blocks if sp_kv_blocks is not None
+                else self._pool.n_blocks)
+        n_sp = -(-n_sp // sp) * sp  # shard the block axis evenly
+        # staged-head span with CHUNK HEADROOM: a prefix hit offsets
+        # the chunk grid, so the final chunk's bucketed width can cross
+        # the table-span boundary (c0 + wc up to w - 1 + chunk_cap) —
+        # without the headroom the model's cached write would silently
+        # clamp, exactly the overflow the non-sp private cache sizes
+        # wp = w + chunk_cap against
+        self._mb_sp = -(-(self._w + self._chunk_cap) // bs_kv)
+        self._sp_pool = SeqShardedBlockPool(n_sp, bs_kv, sp)
+        sp_tree = init_block_pool(config, n_sp, bs_kv)
+        specs = match_partition_rules(KV_POOL_RULES, sp_tree)
+        pool_sh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs)
+        # sparkdl-lint: disable=lock-discipline -- constructor path: the engine loop thread does not exist until auto_start, so no concurrent reader
+        self._sp_pool_kv = jax.device_put(sp_tree, pool_sh)
+        rep = NamedSharding(mesh, P())
+        ids_sh = NamedSharding(
+            mesh, sequence_activation_spec(ndim=2, seq_dim=1))
+        logits_sh = NamedSharding(
+            mesh, sequence_activation_spec(ndim=3, seq_dim=1))
+        # host-side arithmetic for sparkdl_sp_permute_bytes_total: each
+        # chip contributes its K/V chunk shard to sp-1 peers
+        self._sp_bytes_per_col = (
+            2 * n_layers * config.hidden_size
+            * np.dtype(config.dtype).itemsize * (sp - 1))
+
+        @functools.partial(
+            jax.jit, donate_argnums=(1,), static_argnums=(7,),
+            in_shardings=(rep, pool_sh, rep, rep, ids_sh, rep, rep),
+            out_shardings=(logits_sh, pool_sh))
+        def _sp_chunk(variables, sppool, head, idx, ids, sblk, soff,
+                      nbh):
+            # One SPATIAL prefill chunk: gather the staged head
+            # (sentinels clip to causally-masked garbage), write this
+            # chunk's K/V into it through the model's cached path —
+            # queries sharded over sp, K all-gathered by GSPMD for the
+            # dense masked softmax, so logits are bitwise-identical to
+            # the single-device chunk — then scatter the freshly
+            # written columns back to their staged blocks (sentinel
+            # targets drop: pad columns never land).
+            wc = ids.shape[1]
+            kbuf = sppool["k"][:, head].reshape(
+                n_layers, 1, nbh * bs_kv, nh, hd)
+            vbuf = sppool["v"][:, head].reshape(
+                n_layers, 1, nbh * bs_kv, nh, hd)
+            positions = jnp.minimum(
+                idx + jnp.arange(wc)[None, :], max_pos)
+            cache = {"k": kbuf, "v": vbuf, "idx": idx}
+            logits, cache = model.apply(
+                variables, ids, cache=cache, positions=positions)
+            newk = jax.lax.dynamic_slice_in_dim(
+                cache["k"][:, 0], idx, wc, axis=1)
+            newv = jax.lax.dynamic_slice_in_dim(
+                cache["v"][:, 0], idx, wc, axis=1)
+            ix = (slice(None), sblk, soff)
+            out = dict(sppool)
+            out["k"] = sppool["k"].at[ix].set(newk, mode="drop")
+            out["v"] = sppool["v"].at[ix].set(newv, mode="drop")
+            return logits, out
+
+        @functools.partial(
+            jax.jit, donate_argnums=(0,),
+            in_shardings=(pool_sh, rep, rep, rep),
+            out_shardings=pool_sh)
+        def _sp_seed(sppool, kdata, vdata, ids):
+            # cached-prefix K/V -> the staged blocks backing the hit
+            # span (the prefix gather, sharded along the same axis):
+            # whole-block writes, sentinel targets drop
+            out = dict(sppool)
+            out["k"] = sppool["k"].at[:, ids].set(kdata, mode="drop")
+            out["v"] = sppool["v"].at[:, ids].set(vdata, mode="drop")
+            return out
+
+        @functools.partial(
+            jax.jit,
+            in_shardings=(pool_sh, rep), out_shardings=(rep, rep))
+        def _sp_gather(sppool, ids):
+            # prefill->decode handoff: the request's staged blocks,
+            # gathered ONCE across the sp shards (replicated out; the
+            # host hop to the single-device decode pool is the
+            # documented boundary between the two device worlds)
+            return sppool["k"][:, ids], sppool["v"][:, ids]
+
+        _dq = self._dq_gather_fn
+        _qw = self._q_write_fn
+
+        @jax.jit
+        def _sp_prefix_fetch(pool, gids):
+            # cached prefix blocks out of the DECODE pool, dequantized
+            # to the compute dtype (the same values the single-device
+            # first chunk gathers into its private cache)
+            return _dq(pool, "k", gids), _dq(pool, "v", gids)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _sp_install(pool, kdata, vdata, inst):
+            # the handoff install into the decode pool's owned blocks:
+            # the same _q_write path as the fused single-device install
+            # (sentinels at shared-prefix positions drop; quantized
+            # pools quantize HERE, once)
+            return _qw(pool, (inst,), kdata, vdata)
+
+        self._sp_chunk_fn = _sp_chunk
+        self._sp_seed_fn = _sp_seed
+        self._sp_gather_fn = _sp_gather
+        self._sp_prefix_fetch_fn = _sp_prefix_fetch
+        self._sp_install_fn = _sp_install
+
     # -- submission ----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int, *,
                timeout_s: float | None = None) -> Future:
@@ -755,6 +1004,15 @@ class ContinuousGPTEngine:
                     f"{self._pool.n_blocks}: it can never fit — raise "
                     "kv_blocks or shorten the request"
                 )
+            if self.sp > 1:
+                nbp = -(-len(prompt) // self._kv_bs)
+                if nbp > self._sp_pool.n_blocks:
+                    raise ValueError(
+                        f"prompt needs {nbp} staging blocks but the "
+                        f"sp pool holds {self._sp_pool.n_blocks}: it "
+                        "can never prefill — raise sp_kv_blocks or "
+                        "shorten the prompt"
+                    )
         else:
             lp = pick_bucket(len(prompt), self._len_buckets)
             if lp + max_new_tokens > self.max_len:
@@ -800,6 +1058,8 @@ class ContinuousGPTEngine:
         self._obs.close(drain=drain)
         if self.kv_layout == "paged":
             self._pool.close()
+            if self.sp > 1:
+                self._sp_pool.close()
 
     def _loop(self) -> None:
         try:
@@ -864,7 +1124,9 @@ class ContinuousGPTEngine:
                         deferred = True
                         break
                 if (not deferred and self.kv_layout == "paged"
-                        and self._pool.deferral_streak):
+                        and (self._pool.deferral_streak
+                             or (self.sp > 1
+                                 and self._sp_pool.deferral_streak))):
                     # free slots existed and nothing deferred this tick
                     # (the deferred work admitted, or left the queue —
                     # e.g. expired): the exhaustion episode is over. A
@@ -874,6 +1136,8 @@ class ContinuousGPTEngine:
                     # postmortem trigger. (The pool also clears the
                     # streak itself whenever release() frees blocks.)
                     self._pool.reset_deferral_streak()
+                    if self.sp > 1:
+                        self._sp_pool.reset_deferral_streak()
             else:
                 self.queue.sweep_expired()  # deadlines don't wait for slots
             did_work = False
@@ -886,34 +1150,44 @@ class ContinuousGPTEngine:
             return did_work
 
     def _defer(self, reqs: "list[Request]") -> None:
-        """KV pool exhaustion: re-queue in order, count the streak, and
-        after ``_EXHAUST_DUMP_STREAK`` consecutive deferrals hand the
-        flight recorder a postmortem trigger (providers capture the
-        pool state). Self-recovering: blocks free as slots retire."""
+        """KV pool exhaustion: re-queue in order, count the streak ON
+        THE POOL THAT ACTUALLY DEFERRED (``_admit_paged`` marks
+        ``_defer_pool`` — decode pool or the sp staging pool; a staging
+        stall recorded against the decode pool would read healthy and
+        never trip the postmortem), and after ``_EXHAUST_DUMP_STREAK``
+        consecutive deferrals hand the flight recorder a postmortem
+        trigger (providers capture the pool state). Self-recovering:
+        blocks free as slots retire."""
         self.queue.requeue(reqs)
         self._deferrals += 1
         gen: GenRequest = reqs[0].payload
+        pool = self._defer_pool
+        staging = pool is not self._pool
         # the recovery bar: worst-case blocks of the request being owed
         # (ignores prefix-cache sharing — a conservative overestimate,
         # so a partial free can never clear a streak the request's
-        # admission would still defer on)
-        need = -(-(len(gen.prompt) + gen.max_new_tokens) // self._kv_bs)
-        self._pool.record_deferral(need=need)
-        streak = self._pool.deferral_streak
+        # admission would still defer on). Staging holds prompt blocks
+        # only; the decode pool the full prompt + budget span.
+        span = (len(gen.prompt) if staging
+                else len(gen.prompt) + gen.max_new_tokens)
+        pool.record_deferral(need=-(-span // self._kv_bs))
+        streak = pool.deferral_streak
         flight_mod.record_event(
             "kv.admission_deferred",
             engine=getattr(self._obs, "name", None),
             request_id=reqs[0].request_id,
             deferred=len(reqs),
             streak=streak,
-            blocks_free=self._pool.free_count,
-            blocks_total=self._pool.n_blocks,
+            pool="sp_staging" if staging else "decode",
+            blocks_free=pool.free_count,
+            blocks_total=pool.n_blocks,
         )
         if streak == _EXHAUST_DUMP_STREAK:
             flight_mod.trigger_dump(
                 "kv.pool_exhausted",
                 streak=streak,
-                blocks_total=self._pool.n_blocks,
+                pool="sp_staging" if staging else "decode",
+                blocks_total=pool.n_blocks,
             )
 
     def _admit(self, slot: int, req: Request) -> bool:
@@ -984,6 +1258,7 @@ class ContinuousGPTEngine:
             owned = None
         if owned is None:
             self._prefix.release(matched)
+            self._defer_pool = self._pool
             return False
         # the first chunk will gather the cached prefix into the private
         # prefill cache (also the COW copy of a partial tail block);
@@ -998,6 +1273,46 @@ class ContinuousGPTEngine:
         n_shared = len(m.full_blocks)
         inst = np.full((self._mb,), self._pool.sentinel, np.int32)
         inst[n_shared:n_shared + len(owned)] = owned
+        sp_blocks = None
+        cow = m.partial_block
+        if self.sp > 1:
+            # sequence-parallel staging: the prompt's K/V accumulate in
+            # sp-sharded blocks (striped across chips), allocated up
+            # front like the decode blocks — exhaustion defers
+            try:
+                sp_blocks = self._sp_pool.allocate(
+                    -(-plen // self._kv_bs))
+            except Exception as e:
+                # an injected kv.alloc fault on the STAGING allocate is
+                # exhaustion too — defer, never fail the request (and
+                # never leak the decode blocks already taken above)
+                flight_mod.record_event(
+                    "kv.alloc_error", error=type(e).__name__,
+                    request_id=req.request_id)
+                sp_blocks = None
+            if sp_blocks is None:
+                # staging exhausted: same deferral contract as the
+                # decode pool — the caller's _defer records the streak
+                # on the STAGING pool (the one actually short)
+                self._prefix.release(matched + owned)
+                self._defer_pool = self._sp_pool
+                return False
+            if m.full_blocks or cow is not None:
+                try:
+                    self._sp_seed_prefix(gids, sp_blocks,
+                                         len(m.full_blocks)
+                                         + (cow is not None))
+                except Exception:
+                    self._sp_pool.release(
+                        self._sp_pool.deref(sp_blocks))
+                    self._prefix.release(matched + owned)
+                    raise
+                if cow is not None:
+                    # the COW copy is dispatched into the staged block:
+                    # the sp chunks never read the decode pool again, so
+                    # the partial tail's extra hold can drop now
+                    self._prefix.release([cow])
+                    cow = None
         self._prefix.record_lookup(m.hit_tokens, plen - m.hit_tokens)
         if m.hit_tokens:
             flight_mod.record_event(
@@ -1008,10 +1323,27 @@ class ContinuousGPTEngine:
             pos=m.hit_tokens, hit=m.hit_tokens,
             shared=m.full_blocks, owned=owned,
             gather_ids=gids, install_ids=inst,
-            cow_block=m.partial_block,
+            cow_block=cow, sp_blocks=sp_blocks,
         )
         self._pool.reset_deferral_streak()
+        if self.sp > 1:
+            self._sp_pool.reset_deferral_streak()
         return True
+
+    def _sp_seed_prefix(self, gids: np.ndarray, sp_blocks: "list[int]",
+                        n_hit_blocks: int) -> None:
+        """Copy the matched prefix span (full blocks + COW partial
+        tail) from the decode pool into the staged blocks backing it —
+        one dequantizing fetch, one sharded seed scatter."""
+        import jax.numpy as jnp
+
+        seed = np.full((self._mb,), self._sp_pool.sentinel, np.int32)
+        seed[:n_hit_blocks] = sp_blocks[:n_hit_blocks]
+        kd, vd = self._sp_prefix_fetch_fn(
+            self._pool_kv, jnp.asarray(gids))
+        self._sp_pool_kv = self._sp_seed_fn(
+            self._sp_pool_kv, np.asarray(kd), np.asarray(vd),
+            jnp.asarray(seed))
 
     def _alloc_blocks(self, n: int) -> "list[int] | None":
         got = self._pool.allocate(n)
@@ -1051,6 +1383,9 @@ class ContinuousGPTEngine:
                             r: int) -> None:
         import jax.numpy as jnp
 
+        if st.sp_blocks is not None:
+            self._sp_chunk_step(slot, st, r)
+            return
         c0 = st.pos
         first = st.ck is None
         final = c0 + r == len(st.prompt)
@@ -1127,6 +1462,124 @@ class ContinuousGPTEngine:
         self._inflight[slot] = flight
         if self._is_done(flight):  # max_new_tokens=1, or instant eos
             self._complete(slot)
+
+    # -- sequence-parallel chunk dispatch + handoff ---------------------------
+    def _sp_chunk_step(self, slot: int, st: _Prefill, r: int) -> None:
+        """One SPATIAL prefill chunk (sp > 1): ``r`` real tokens
+        dispatched across the sp chips — queries sharded, K/V
+        all-gathered, staged blocks scattered back sharded. The final
+        chunk triggers the prefill→decode handoff. Dispatches record
+        under ``sparkdl_dispatch_seconds{path="sp_prefill"}`` and NEVER
+        feed the ChainPolicy: its calibrated dispatch gap is measured
+        on single-device programs, and a collective-bearing dispatch
+        would skew the auto-K the decode loop calibrates from."""
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.runtime.batching import pow2_bucket
+
+        try:
+            # the injectable stand-in for a failed collective hop
+            # (ring permute / all-gather): fires BEFORE the dispatch so
+            # the donated staging pool is never half-consumed — the
+            # chaos contract re-queues the victim, losing nothing
+            fault_point("sp.permute")
+        except Exception as e:
+            self._sp_abort(slot, st, "sp.permute", e)
+            return
+        c0 = st.pos
+        final = c0 + r == len(st.prompt)
+        bs = self._kv_bs
+        wc = pow2_bucket(r, max(8, self.sp), self._chunk_cap)
+        ids = np.zeros((1, wc), np.int32)
+        ids[0, :r] = st.prompt[c0:c0 + r]
+        # staged head covering [0, c0+wc): bucketed block count for
+        # compile reuse; sentinel where the prompt span ends. The cap
+        # is _mb_sp (table span + chunk headroom), NOT _mb: a
+        # hit-offset final chunk can reach past the table span, and a
+        # clamped cached write would corrupt real columns
+        nbh = pow2_bucket(-(-(c0 + wc) // bs), 1, self._mb_sp)
+        head = np.full((nbh,), self._sp_pool.sentinel, np.int32)
+        n_have = min(len(st.sp_blocks), nbh)
+        head[:n_have] = st.sp_blocks[:n_have]
+        # scatter targets for this chunk's columns; pad columns (>= r)
+        # go to the sentinel and drop
+        cols = c0 + np.arange(wc)
+        sblk = np.full((wc,), self._sp_pool.sentinel, np.int32)
+        real = np.arange(wc) < r
+        sblk[real] = np.asarray(st.sp_blocks, np.int32)[
+            cols[real] // bs]
+        soff = (cols % bs).astype(np.int32)
+        t0 = time.perf_counter()
+        with span("serving.sp_prefill_chunk", parent=st.req.trace_ctx,
+                  request_id=st.req.request_id, slot=slot, start=c0,
+                  tokens=r, sp=self.sp, final=final):
+            logits, self._sp_pool_kv = self._sp_chunk_fn(
+                self.variables, self._sp_pool_kv, jnp.asarray(head),
+                jnp.asarray(c0, jnp.int32), jnp.asarray(ids),
+                jnp.asarray(sblk), jnp.asarray(soff), int(nbh))
+        record_dispatch("sp_prefill", 1, time.perf_counter() - t0)
+        _M_SP_RING_STEPS.inc(self.sp - 1)
+        _M_SP_PERMUTE_BYTES.inc(self._sp_bytes_per_col * wc)
+        st.pos += r
+        st.chunks += 1
+        self._prefill_chunks += 1
+        _M_PREFILL_CHUNKS.inc()
+        if final:
+            first = int(jnp.argmax(logits[0, r - 1]))
+            if self._sp_handoff(slot, st):
+                self._finish_prefill(slot, st, first)
+        self._prefill_seconds += time.perf_counter() - t0
+
+    def _sp_handoff(self, slot: int, st: _Prefill) -> bool:
+        """Prefill→decode handoff: gather the request's staged K/V once
+        across the sp shards and install it into the decode pool's
+        owned blocks — after this the per-token loop is EXACTLY the
+        single-device paged path. Returns False when the ``sp.gather``
+        fault site fired (request re-queued, nothing lost)."""
+        import jax.numpy as jnp
+
+        try:
+            fault_point("sp.gather")
+        except Exception as e:
+            self._sp_abort(slot, st, "sp.gather", e)
+            return False
+        gids = np.full((self._mb,), self._sp_pool.sentinel, np.int32)
+        gids[:len(st.sp_blocks)] = st.sp_blocks
+        with span("serving.sp_handoff", parent=st.req.trace_ctx,
+                  request_id=st.req.request_id, sp=self.sp):
+            kd, vd = self._sp_gather_fn(
+                self._sp_pool_kv, jnp.asarray(gids))
+            # host hop: the staged world is mesh-committed, the decode
+            # pool single-device — one bounded copy per ADMISSION, not
+            # per token
+            self._pool_kv = self._sp_install_fn(
+                self._pool_kv, np.asarray(kd), np.asarray(vd),
+                jnp.asarray(st.install_ids))
+        self._sp_handoffs += 1
+        self._release_sp_staging(st)
+        return True
+
+    def _sp_abort(self, slot: int, st: _Prefill, site: str,
+                  exc: Exception) -> None:
+        """A collective fault mid-sp-prefill: tear the prefill down,
+        release every block it holds (staging AND decode pool), and
+        re-queue the request at the head — zero lost admitted
+        requests; the typed error lands in the flight ring."""
+        del self._prefilling[slot]
+        self._release_sp_staging(st)
+        self._prefix.release(st.all_blocks())
+        err = SpCollectiveError(f"{site} failed: {exc!r}")
+        flight_mod.record_event(
+            "sp.collective_failed", site=site,
+            error=type(err).__name__, cause=type(exc).__name__,
+            request_id=st.req.request_id, sp=self.sp,
+            prefilled=st.pos, prompt_tokens=len(st.prompt))
+        self.queue.requeue([st.req])
+
+    def _release_sp_staging(self, st: _Prefill) -> None:
+        if st.sp_blocks:
+            self._sp_pool.release(self._sp_pool.deref(st.sp_blocks))
+            st.sp_blocks = None
 
     def _release_slot(self, slot: int,
                       blocks: "list[int] | None") -> None:
@@ -1448,6 +1901,7 @@ class ContinuousGPTEngine:
             if st.req.expired(now):
                 self._prefilling.pop(slot)
                 self._release_slot(slot, st.all_blocks())
+                self._release_sp_staging(st)
                 self._fail_request(
                     st.req,
                     DeadlineExceededError(
@@ -1464,6 +1918,7 @@ class ContinuousGPTEngine:
         for slot in list(self._prefilling):
             st = self._prefilling.pop(slot)
             self._release_slot(slot, st.all_blocks())
+            self._release_sp_staging(st)
             self._fail_request(st.req, exc, tokens=0)
 
     # -- introspection -------------------------------------------------------
@@ -1510,12 +1965,24 @@ class ContinuousGPTEngine:
             "prefill_chunk": self.prefill_chunk,
             "prefill_chunks": self._prefill_chunks,
             "deferrals_total": self._deferrals,
-            "exhausted_streak": self._pool.deferral_streak,
+            # the MAX of decode + staging streaks: /healthz reads this
+            # as degraded, and a staging-only stall must degrade too
+            "exhausted_streak": max(
+                self._pool.deferral_streak,
+                self._sp_pool.deferral_streak if self.sp > 1 else 0),
             "dtype": self.kv_dtype,
             "bytes_per_token": kv_bytes_per_token(
                 self.config, self.kv_dtype),
             "capacity_ratio_vs_fp32": round(kv_capacity_ratio(
                 self.config, self.kv_dtype), 4),
+            **({"sp": {
+                "axis": self.sp,
+                "staging_blocks_total": self._sp_pool.n_blocks,
+                "staging_blocks_used": self._sp_pool.used_count,
+                "staging_streak": self._sp_pool.deferral_streak,
+                "shard_used": self._sp_pool.shard_used_counts(),
+                "handoffs": self._sp_handoffs,
+            }} if self.sp > 1 else {}),
         }
 
     def _spec_snapshot(self) -> "dict[str, Any] | None":

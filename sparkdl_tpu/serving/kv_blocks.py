@@ -55,6 +55,10 @@ _M_DEFERRED = registry().counter(
 _M_DTYPE = registry().gauge(
     "sparkdl_kv_pool_dtype",
     "live KV block pools by storage layout", labels=("dtype",))
+_M_SP_IMBALANCE = registry().gauge(
+    "sparkdl_sp_shard_imbalance",
+    "sequence-sharded pool imbalance: (max - min) used blocks across "
+    "sp shards / blocks per shard (0 = perfectly balanced)")
 
 #: Supported pool storage layouts: "fp32" stores at the model's compute
 #: dtype (exact, the default), "bf16"/"int8" compress the resident pool
@@ -161,7 +165,7 @@ class KVBlockPool:
     @property
     def used_count(self) -> int:
         """Blocks off the free list: live slots + cached prefixes."""
-        return self.n_blocks - len(self._free)
+        return self.n_blocks - self.free_count
 
     def refcount(self, block_id: int) -> int:
         return self._ref[block_id]
@@ -177,14 +181,20 @@ class KVBlockPool:
         fault_point("kv.alloc")
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
-        if n > len(self._free):
+        if n > self.free_count:
             return None
-        out = [self._free.popleft() for _ in range(n)]
+        out = [self._pop_block() for _ in range(n)]
         for bid in out:
             self._ref[bid] = 1
             self._is_free[bid] = False
         self._update_gauges()
         return out
+
+    def _pop_block(self) -> int:
+        """Take one free block (subclass hook, the mirror of
+        :meth:`_free_block` — the sharded pool pops round-robin across
+        its shard stripes). Only called with ``free_count`` cover."""
+        return self._free.popleft()
 
     def ref(self, block_ids: Iterable[int]) -> None:
         """Add one reference per id. Refcount 0 is legal here — that is
@@ -231,12 +241,17 @@ class KVBlockPool:
                 )
             if self._is_free[bid]:
                 raise RuntimeError(f"double free of block {bid}")
-            self._free.append(bid)
+            self._free_block(bid)
             self._is_free[bid] = True
             freed += 1
-        if freed and len(self._free) >= self._deferred_need:
+        if freed and self.free_count >= self._deferred_need:
             self.deferral_streak = 0
         self._update_gauges()
+
+    def _free_block(self, bid: int) -> None:
+        """Return one block to the free structure (subclass hook —
+        the sharded pool files it under its shard's stripe)."""
+        self._free.append(bid)
 
     def record_deferral(self, need: "int | None" = None) -> None:
         """Count one deferral; ``need`` is the worst-case block count
@@ -271,3 +286,119 @@ class KVBlockPool:
         self._g_total.set(0)
         self._g_used.set(0)
         self._g_dtype.set(0)
+
+
+class SeqShardedBlockPool(KVBlockPool):
+    """A :class:`KVBlockPool` whose physical blocks live sequence-sharded
+    across ``sp`` chips (ISSUE 13 / ROADMAP item 2).
+
+    The device pool array ``[layers, n_blocks, block_size, H, D]`` is
+    placed with its block axis on the ``sp`` mesh axis (contiguous
+    shards: chip ``c`` holds blocks
+    ``[c * blocks_per_shard, (c+1) * blocks_per_shard)``), so a long
+    context's resident KV never has to fit one chip — the table maps a
+    VIRTUAL block id to ``(chip, local block)`` via :meth:`shard_of` /
+    :meth:`local_id`, exactly the contiguous layout
+    :func:`jax.sharding.NamedSharding` gives ``P(None, "sp")``.
+
+    Allocation is **striped**: :meth:`allocate` round-robins across
+    per-shard free lists so one sequence's blocks spread over chips
+    (consecutive virtual columns land on alternating chips, which is
+    what makes the per-chunk head gather an all-to-all instead of one
+    hot chip) and no shard exhausts while its peers sit idle. The
+    ``sparkdl_sp_shard_imbalance`` gauge publishes
+    ``(max - min) used blocks across shards / blocks_per_shard`` so an
+    operator can see striping degrade (e.g. a workload of exactly
+    shard-sized sequences). Refcounts, deferral streaks, and the free /
+    release contracts are the base class's — sharing (COW, prefix
+    reuse) works across shards because block ids stay virtual
+    everywhere above the device layout.
+    """
+
+    def __init__(self, n_blocks: int, block_size: int, sp: int,
+                 dtype: str = "fp32"):
+        if sp < 1:
+            raise ValueError(f"sp must be >= 1, got {sp}")
+        if n_blocks % sp:
+            raise ValueError(
+                f"n_blocks {n_blocks} not divisible by sp={sp}: the "
+                "device pool shards its block axis evenly across chips")
+        super().__init__(n_blocks, block_size, dtype=dtype)
+        self.sp = sp
+        self.blocks_per_shard = n_blocks // sp
+        # striped per-shard free lists REPLACE the base deque (cleared
+        # below so no stale membership survives); _is_free stays the
+        # authoritative free-ness record, and per-shard used counters
+        # are maintained incrementally — every pool operation stays
+        # O(allocated blocks), never O(n_blocks)
+        self._free.clear()
+        self._shard_free: "list[collections.deque[int]]" = [
+            collections.deque(range(s * self.blocks_per_shard,
+                                    (s + 1) * self.blocks_per_shard))
+            for s in range(sp)
+        ]
+        self._shard_used = [0] * sp
+        self._next_shard = 0
+        # imbalance rides GaugeShare like every other gauge here:
+        # concurrent pools SUM their contributions (one pool — the
+        # common case — reads exactly its own skew) and close()
+        # retracts this pool's share. Materialize the zero sample up
+        # front: GaugeShare only writes on CHANGE, so a pool that stays
+        # perfectly balanced would otherwise never create the series and
+        # the family's presence in snapshots (a bench-contract assert)
+        # would depend on runtime allocation skew.
+        _M_SP_IMBALANCE.inc(0.0)
+        self._g_imb = GaugeShare(_M_SP_IMBALANCE)
+        self._update_imbalance()
+
+    # -- virtual id -> device placement --------------------------------------
+    def shard_of(self, block_id: int) -> int:
+        """Which sp chip holds this virtual block."""
+        return block_id // self.blocks_per_shard
+
+    def local_id(self, block_id: int) -> int:
+        """The block's index within its chip's shard."""
+        return block_id % self.blocks_per_shard
+
+    def shard_used_counts(self) -> "list[int]":
+        """Used (off-free-list) blocks per shard, virtual-order."""
+        return list(self._shard_used)
+
+    @property
+    def free_count(self) -> int:
+        return sum(len(d) for d in self._shard_free)
+
+    # -- striped allocation ---------------------------------------------------
+    def _pop_block(self) -> int:
+        # round-robin across shards from the stripe cursor (the base
+        # allocate guarantees free_count cover, so a non-empty shard
+        # exists) — allocation contract, fault site, and gauges are the
+        # base class's; only the pop ORDER changes
+        while True:
+            shard = self._next_shard % self.sp
+            self._next_shard += 1
+            if self._shard_free[shard]:
+                self._shard_used[shard] += 1
+                return self._shard_free[shard].popleft()
+
+    def _free_block(self, bid: int) -> None:
+        shard = self.shard_of(bid)
+        self._shard_free[shard].append(bid)
+        self._shard_used[shard] -= 1
+
+    def _update_gauges(self) -> None:
+        super()._update_gauges()
+        self._update_imbalance()
+
+    def _update_imbalance(self) -> None:
+        if getattr(self, "blocks_per_shard", 0):
+            used = self._shard_used
+            self._g_imb.set(
+                0.0 if self._closed
+                else (max(used) - min(used)) / self.blocks_per_shard)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        super().close()
+        self._g_imb.set(0.0)

@@ -1,0 +1,57 @@
+"""Mesh-factory ``sp`` axis units (ISSUE 13): inference, divisibility,
+overlap rejection — the loud-at-construction contract extended to the
+sequence axis."""
+
+import jax
+import pytest
+
+from sparkdl_tpu.partition.mesh_factory import (
+    MeshShapeError,
+    axis_sizes,
+    make_custom_mesh,
+    make_mesh,
+)
+
+
+def test_sp_axis_present_and_sized():
+    mesh = make_mesh(dp=1, sp=2, devices=jax.devices()[:2])
+    assert axis_sizes(mesh)["sp"] == 2
+    assert axis_sizes(mesh)["dp"] == 1
+
+
+def test_sp_inferred_from_minus_one():
+    # sp=-1 infers the residual after the named axes (8 devices, dp=2
+    # pinned -> sp=4)
+    mesh = make_mesh(dp=2, sp=-1)
+    assert axis_sizes(mesh)["sp"] == 4
+
+
+def test_sp_composes_with_dp_inference():
+    # default dp=-1 absorbs what sp leaves (8 devices, sp=4 -> dp=2)
+    mesh = make_mesh(sp=4)
+    assert axis_sizes(mesh)["sp"] == 4
+    assert axis_sizes(mesh)["dp"] == 2
+
+
+def test_sp_non_divisor_raises_mesh_shape_error():
+    with pytest.raises(MeshShapeError) as exc:
+        make_mesh(dp=1, sp=3, devices=jax.devices()[:8])
+    assert "8" in str(exc.value)  # device count named in the message
+
+
+def test_sp_invalid_size_raises():
+    with pytest.raises(MeshShapeError):
+        make_mesh(sp=0)
+    with pytest.raises(MeshShapeError):
+        make_mesh(sp=-2)
+
+
+def test_custom_mesh_overlapping_sp_rejected():
+    with pytest.raises(MeshShapeError) as exc:
+        make_custom_mesh([("sp", 2), ("sp", 4)])
+    assert "sp" in str(exc.value)
+
+
+def test_custom_mesh_sp_layout():
+    mesh = make_custom_mesh([("sp", 2), ("tp", -1)])
+    assert axis_sizes(mesh) == {"sp": 2, "tp": 4}

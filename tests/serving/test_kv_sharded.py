@@ -1,0 +1,96 @@
+"""SeqShardedBlockPool units (ISSUE 13): striped allocation, the
+virtual-id -> (chip, local block) mapping, refcounts across shards, and
+the shard-imbalance gauge."""
+
+import pytest
+
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.serving.kv_blocks import KVBlockPool, SeqShardedBlockPool
+
+
+def test_divisibility_validated():
+    with pytest.raises(ValueError, match="divisible"):
+        SeqShardedBlockPool(10, 4, sp=4)
+    with pytest.raises(ValueError, match="sp"):
+        SeqShardedBlockPool(8, 4, sp=0)
+
+
+def test_virtual_to_chip_local_mapping():
+    pool = SeqShardedBlockPool(8, 4, sp=2)
+    assert pool.blocks_per_shard == 4
+    # contiguous shards: the NamedSharding(P(None, "sp")) layout
+    assert [pool.shard_of(b) for b in range(8)] == [0] * 4 + [1] * 4
+    assert [pool.local_id(b) for b in range(8)] == [0, 1, 2, 3] * 2
+
+
+def test_striped_allocation_balances_shards():
+    pool = SeqShardedBlockPool(8, 4, sp=2)
+    got = pool.allocate(4)
+    # round-robin across shards: 2 blocks from each
+    shards = [pool.shard_of(b) for b in got]
+    assert shards.count(0) == 2 and shards.count(1) == 2, got
+    assert pool.shard_used_counts() == [2, 2]
+
+
+def test_striping_skips_exhausted_shard():
+    pool = SeqShardedBlockPool(8, 4, sp=2)
+    a = pool.allocate(6)  # 3 per shard
+    b = pool.allocate(2)
+    # shard balance holds through both allocations
+    assert pool.shard_used_counts() == [4, 4]
+    assert pool.free_count == 0
+    assert pool.allocate(1) is None  # defers, never errors
+    # free one shard-0 block: next alloc must come from shard 0
+    first0 = next(x for x in a if pool.shard_of(x) == 0)
+    pool.release(pool.deref([first0]))
+    got = pool.allocate(1)
+    assert pool.shard_of(got[0]) == 0
+    del b
+
+
+def test_refcounts_across_shards():
+    """A block on shard 1 shared by two owners survives the first
+    deref — sharing (COW/prefix reuse) is virtual-id-level, the device
+    shard is irrelevant."""
+    pool = SeqShardedBlockPool(8, 4, sp=2)
+    got = pool.allocate(2)
+    remote = next(b for b in got if pool.shard_of(b) == 1)
+    pool.ref([remote])
+    assert pool.refcount(remote) == 2
+    assert pool.deref([remote]) == []  # still referenced
+    assert pool.deref([remote]) == [remote]
+    pool.release([remote])
+    assert pool.free_count == 7
+
+
+def test_imbalance_gauge_tracks_skew():
+    registry().reset()
+    pool = SeqShardedBlockPool(8, 4, sp=2)
+    pool.allocate(4)  # striped: balanced
+    fam = registry().get("sparkdl_sp_shard_imbalance")
+    # the series must EXIST at zero skew (bench contract asserts the
+    # family's presence), not only once imbalance first goes nonzero
+    assert fam.snapshot_values() == {"": 0.0}
+    # force skew: free both shard-1 blocks
+    used1 = [b for b in range(8)
+             if not pool._is_free[b] and pool.shard_of(b) == 1]
+    pool.release(pool.deref(used1))
+    assert fam.snapshot_values().get("") == pytest.approx(2 / 4)
+    pool.close()
+
+
+def test_base_pool_contracts_inherited():
+    """Deferral streaks, double-free detection, sentinel — the base
+    KVBlockPool contracts hold unchanged."""
+    pool = SeqShardedBlockPool(4, 4, sp=2)
+    assert pool.sentinel == 4
+    got = pool.allocate(4)
+    assert pool.allocate(1) is None
+    pool.record_deferral(need=1)
+    assert pool.deferral_streak == 1
+    zeroed = pool.deref(got[:1])
+    pool.release(zeroed)
+    assert pool.deferral_streak == 0  # release covering need clears
+    with pytest.raises(RuntimeError, match="double free"):
+        pool.release(zeroed)
+    assert isinstance(pool, KVBlockPool)
