@@ -56,6 +56,16 @@ and the speedup; and the same workload over a `BENCH_KV_DTYPE`
 hold 2x+ the live tokens) and the `token_agreement_vs_fp32` parity
 delta the compression trades.
 
+Multi-host fabric section (ISSUE 14): the shared-prefix chat workload
+over `BENCH_HOSTS` (default 2; <2 disables) in-process GPT hosts behind
+the cache-aware Router vs round-robin — seed the prefix groups, refresh
+the digests, replay 3 follower rounds (medians of 3), emitting
+`fabric_hosts`, `fabric_hit_rate_routed` / `fabric_hit_rate_rr` (the
+headline gap: affinity routes followers to the host whose radix cache
+holds their prefix), `fabric_p95_ms_routed` / `fabric_p95_ms_rr`, and
+the full `fabric` block (`BENCH_FABRIC_GROUPS`=4 prefix groups,
+`BENCH_FABRIC_REQUESTS`=16 followers/round).
+
 Sequence-parallel long-context section (ISSUE 13): the same long
 prompt (`BENCH_LONG_PROMPT_LEN`=3072) prefilled at sp=1 vs
 sp=`BENCH_SP` (default 2; <2 disables) over forced CPU devices,
@@ -432,6 +442,131 @@ def _gpt_spec_section():
     return out
 
 
+def _fabric_section():
+    """Multi-host fabric (ISSUE 14): the SAME shared-prefix chat
+    workload routed over BENCH_HOSTS in-process GPT hosts by the
+    cache-aware router vs blind round-robin. Per policy: seed each
+    prefix group once, refresh the digests, then replay 3 follower
+    rounds (fresh suffixes — steady-state serving, medians of 3: CPU
+    numbers are bimodal) and read the fleet prefix hit rate off the
+    engines plus client-side p95. The headline is the hit-rate gap:
+    affinity lands followers where their prefix blocks live, so the
+    2.2-2.5x cheaper prefill (PERF.md) actually happens; round-robin
+    scatters them and the fleet re-prefills what another host already
+    cached. None when BENCH_HOSTS < 2."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.fabric import InProcessHost, Router
+    from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+    from sparkdl_tpu.serving import ContinuousGPTEngine
+
+    n_hosts = int(os.environ.get("BENCH_HOSTS", "2"))
+    if n_hosts < 2:
+        return None
+    n_groups = int(os.environ.get("BENCH_FABRIC_GROUPS", "4"))
+    per_round = int(os.environ.get("BENCH_FABRIC_REQUESTS", "16"))
+    share = float(os.environ.get("BENCH_PREFIX_SHARE", "0.75"))
+    plen = int(os.environ.get("BENCH_PROMPT_LEN", "96"))
+    max_new = 8
+    max_len = plen + max_new
+    cfg = GPTConfig(
+        vocab_size=256, hidden_size=128, num_layers=3, num_heads=4,
+        intermediate_size=256, max_seq_len=4 * max_len,
+    )
+    model = GPTLMHeadModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(3), jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(23)
+    n_shared = int(round(share * plen))
+    prefixes = [rng.integers(1, cfg.vocab_size, n_shared).tolist()
+                for _ in range(n_groups)]
+
+    def fresh_followers():
+        # grouped by group (an interleaved order can hand round-robin
+        # accidental parity with the seed placements)
+        return [
+            prefixes[g]
+            + rng.integers(1, cfg.vocab_size, plen - n_shared).tolist()
+            for g in range(n_groups)
+            for _ in range(per_round // n_groups)
+        ]
+
+    def run(policy):
+        engines = [
+            ContinuousGPTEngine(
+                cfg, variables, n_slots=4, max_len=max_len,
+                kv_block_size=8, idle_wait_s=0.0005,
+                host_id=f"bench-{policy}-{i}")
+            for i in range(n_hosts)
+        ]
+        hit_rates, p95s, walls = [], [], []
+        with Router([InProcessHost(e) for e in engines],
+                    policy=policy, auto_refresh=False) as router:
+            # compile warmup + digest seeding: one request per group
+            for g in range(n_groups):
+                router.submit({
+                    "prompt": prefixes[g] + rng.integers(
+                        1, cfg.vocab_size, plen - n_shared).tolist(),
+                    "max_new_tokens": max_new}).result(timeout=300)
+            router.refresh()
+            for _ in range(3):
+                kv0 = [e.snapshot()["kv"] for e in engines]
+                lats = []
+                t0 = time.perf_counter()
+                futs = []
+                for p in fresh_followers():
+                    t_sub = time.perf_counter()
+                    fut = router.submit(
+                        {"prompt": p, "max_new_tokens": max_new})
+                    fut.add_done_callback(
+                        lambda f, t=t_sub:
+                        lats.append(time.perf_counter() - t))
+                    futs.append(fut)
+                for f in futs:
+                    f.result(timeout=300)
+                walls.append(time.perf_counter() - t0)
+                # result() can return before the done-callback that
+                # appends the latency has run: wait for the full sample
+                # (bounded — callbacks fire microseconds later)
+                deadline = time.monotonic() + 5.0
+                while (len(lats) < len(futs)
+                       and time.monotonic() < deadline):
+                    time.sleep(0.001)
+                kv1 = [e.snapshot()["kv"] for e in engines]
+                hits = sum(b["prefix_hits"] - a["prefix_hits"]
+                           for a, b in zip(kv0, kv1))
+                miss = sum(b["prefix_misses"] - a["prefix_misses"]
+                           for a, b in zip(kv0, kv1))
+                hit_rates.append(hits / max(1, hits + miss))
+                p95s.append(float(np.percentile(lats, 95)))
+                router.refresh()  # publish blocks the round cached
+            fleet = router.snapshot()
+        for e in engines:
+            e.close()
+        return {
+            "prefix_hit_rate": round(float(np.median(hit_rates)), 4),
+            "p95_ms": round(1e3 * float(np.median(p95s)), 2),
+            "req_s": round(per_round / float(np.median(walls)), 2),
+            "routed_per_host": {
+                h["host"]: h["routed"] for h in fleet["hosts"]},
+        }
+
+    routed = run("affinity")
+    rr = run("round_robin")
+    return {
+        "hosts": n_hosts,
+        "groups": n_groups,
+        "requests_per_round": per_round,
+        "prefix_share": share,
+        "prompt_len": plen,
+        "routed": routed,
+        "round_robin": rr,
+        "hit_rate_gain": round(
+            routed["prefix_hit_rate"] - rr["prefix_hit_rate"], 4),
+    }
+
+
 def main() -> None:
     n_replicas = int(os.environ.get("BENCH_REPLICAS", "1"))
     n_sp = int(os.environ.get("BENCH_SP", "2"))
@@ -569,6 +704,10 @@ def main() -> None:
     # devices, medians of 3.
     sp_prefill = _gpt_sp_section()
 
+    # Multi-host fabric (ISSUE 14): cache-aware routing vs round-robin
+    # over BENCH_HOSTS in-process hosts, medians of 3.
+    fabric = _fabric_section()
+
     gap = calibrate_dispatch_gap()
     n_dispatches = dispatch_count("serving")
     snap_wall = registry().snapshot().get(
@@ -621,6 +760,19 @@ def main() -> None:
         "sp_prefill_speedup": (sp_prefill or {}).get(
             "sp_prefill_speedup"),
         "sp_prefill": sp_prefill,
+        # Multi-host fabric (ISSUE 14): the cache-aware router's hit
+        # rate vs round-robin on the same shared-prefix fleet workload
+        # (None when BENCH_HOSTS<2)
+        "fabric_hosts": (fabric or {}).get("hosts"),
+        "fabric_hit_rate_routed": (fabric or {}).get(
+            "routed", {}).get("prefix_hit_rate"),
+        "fabric_hit_rate_rr": (fabric or {}).get(
+            "round_robin", {}).get("prefix_hit_rate"),
+        "fabric_p95_ms_routed": (fabric or {}).get(
+            "routed", {}).get("p95_ms"),
+        "fabric_p95_ms_rr": (fabric or {}).get(
+            "round_robin", {}).get("p95_ms"),
+        "fabric": fabric,
         # SLO accounting + flight recorder (ISSUE 9): declared objective
         # with rolling burn, and the event-ring volume this run produced
         "slo": replica_snap.get("slo"),

@@ -209,6 +209,123 @@ print(f"sp smoke OK: sp=2 bitwise vs sp=1 across {len(cases)} requests "
       f"injected {sorted(sites)} faults -> re-queued, zero lost")
 EOF
 
+# Multi-host fabric smoke (ISSUE 14): (a) a 2-host fleet under the
+# cache-aware router must beat round-robin's prefix hit rate on the
+# identical shared-prefix workload, with tokens oracle-exact under both
+# policies; (b) host-kill drill — one host hard-killed under load with
+# injected host.submit faults riding: ZERO lost accepted requests
+# (every Future resolves with the right tokens via failover), the dead
+# host quarantines, and the router's postmortem bundle carries the
+# failover sequence.
+JAX_PLATFORMS=cpu \
+SPARKDL_TPU_FAULT_PLAN="seed=3;host.submit:OSError@5;host.drain:OSError@1" \
+python - <<'EOF'
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.fabric import InProcessHost, Router
+from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel, generate
+from sparkdl_tpu.observability.flight import flight_recorder
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.serving import ContinuousGPTEngine
+
+flight_recorder().configure(settle_s=0.05, min_interval_s=0.0)
+cfg = GPTConfig.tiny()
+model = GPTLMHeadModel(cfg)
+variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+rng = np.random.default_rng(13)
+groups = [rng.integers(1, cfg.vocab_size, 8).tolist() for _ in range(2)]
+seeds = [g + [int(rng.integers(1, cfg.vocab_size))] for g in groups]
+followers = [g + rng.integers(1, cfg.vocab_size, 2).tolist()
+             for g in groups for _ in range(3)]
+
+def make_engine(host_id):
+    return ContinuousGPTEngine(
+        cfg, variables, n_slots=2, max_len=32, kv_block_size=4,
+        idle_wait_s=0.001, host_id=host_id)
+
+def hit_rate(engines):
+    h = m = 0
+    for e in engines:
+        kv = e.snapshot()["kv"]
+        h, m = h + kv["prefix_hits"], m + kv["prefix_misses"]
+    return h / max(1, h + m)
+
+def run(policy):
+    engines = [make_engine(f"{policy}-{i}") for i in range(2)]
+    with Router([InProcessHost(e) for e in engines],
+                policy=policy, auto_refresh=False) as router:
+        for p in seeds:
+            router.submit({"prompt": p, "max_new_tokens": 3}).result(60)
+        router.refresh()
+        futs = [router.submit({"prompt": p, "max_new_tokens": 3})
+                for p in followers]
+        outs = [np.asarray(f.result(60)) for f in futs]
+    rate = hit_rate(engines)
+    for e in engines:
+        e.close()
+    return rate, outs
+
+# (a) affinity beats round-robin, both oracle-exact. The fault plan's
+# 5th host.submit hit injects an OSError mid-run: the failover path
+# must absorb it (zero lost) while the comparison stays valid.
+rr_rate, rr_outs = run("round_robin")
+af_rate, af_outs = run("affinity")
+assert af_rate > rr_rate, (af_rate, rr_rate)
+for p, a, b in zip(followers, af_outs, rr_outs):
+    want = np.asarray(generate(
+        model, variables, jnp.asarray([p], jnp.int32), 3)[0, len(p):])
+    np.testing.assert_array_equal(a, want)
+    np.testing.assert_array_equal(b, want)
+
+# (b) host-kill drill on a fresh 2-host fleet, plus a graceful drain
+# retry through the injected host.drain fault.
+registry().reset()
+engines = [make_engine(f"kill-{i}") for i in range(2)]
+hosts = [InProcessHost(e) for e in engines]
+with Router(hosts, max_failures=3, probation_s=0.5,
+            auto_refresh=False) as router:
+    futs = []
+    for i in range(24):
+        futs.append((i, router.submit(
+            {"prompt": [1 + (i % 9), 2, 3], "max_new_tokens": 2})))
+        if i == 10:
+            engines[0].close(drain=False, timeout_s=5)  # host dies
+    for i, f in futs:
+        got = np.asarray(f.result(60))  # zero lost: all resolve
+        p = [1 + (i % 9), 2, 3]
+        want = np.asarray(generate(
+            model, variables, jnp.asarray([p], jnp.int32), 2)[0, 3:])
+        np.testing.assert_array_equal(got, want)
+    assert router._hosts["kill-0"].quarantined
+    moved = router.drain_host("kill-1")  # retries the injected fault
+    assert moved == 0  # nothing queued: traffic already drained
+
+def bundle_ok():
+    b = flight_recorder().last_bundle
+    if b is None:
+        return False
+    kinds = [e.get("kind") for e in b["events"]]
+    return ("fabric.host_quarantined" in kinds
+            and "fabric.failover" in kinds)
+
+import time
+deadline = time.monotonic() + 10.0
+while not bundle_ok():
+    assert time.monotonic() < deadline, "postmortem bundle never settled"
+    time.sleep(0.02)
+snap = registry().snapshot()
+inj = snap["sparkdl_faults_injected_total"]["values"]
+assert inj.get('site="host.drain"', 0) >= 1, inj
+ret = snap["sparkdl_retries_total"]["values"]
+assert ret.get('site="host.drain",outcome="recovered"', 0) >= 1, ret
+for e in engines:
+    e.close(drain=False)
+print(f"fabric smoke OK: affinity hit-rate {af_rate:.2f} > "
+      f"round-robin {rr_rate:.2f} (oracle-exact both), host-kill -> "
+      "zero lost + quarantine + postmortem, drain fault recovered")
+EOF
+
 # Online serving bench: same one-JSON-line contract; vs_baseline is the
 # micro-batch / batch-of-1 throughput ratio under open-loop Poisson load.
 # BENCH_SPEC_K/BENCH_KV_DTYPE are pinned: the contract below asserts the
@@ -273,8 +390,22 @@ assert rec["sp_prefill_speedup"] >= 1.333, spf
 assert "sparkdl_sp_ring_steps_total" in obs, sorted(obs)
 assert "sparkdl_sp_permute_bytes_total" in obs, sorted(obs)
 assert "sparkdl_sp_shard_imbalance" in obs, sorted(obs)
+# ISSUE 14: multi-host fabric — the cache-aware router must beat
+# round-robin prefix hit rate on the shared-prefix fleet workload,
+# with p95s measured for both, and the fabric metric families live
+fb = rec["fabric"]
+assert rec["fabric_hosts"] == 2, rec["fabric_hosts"]
+assert rec["fabric_hit_rate_routed"] > rec["fabric_hit_rate_rr"], fb
+assert rec["fabric_hit_rate_routed"] > 0.5, fb
+assert rec["fabric_p95_ms_routed"] > 0, fb
+assert rec["fabric_p95_ms_rr"] > 0, fb
+assert sum(fb["routed"]["routed_per_host"].values()) >= \
+    fb["requests_per_round"], fb
+assert "sparkdl_fabric_routed_total" in obs, sorted(obs)
+assert "sparkdl_fabric_affinity_hits_total" in obs, sorted(obs)
+assert "sparkdl_fabric_digest_blocks" in obs, sorted(obs)
 print("bench_serving contract OK (snapshot + slo + flight + kv + spec "
-      "+ sp embedded)")
+      "+ sp + fabric embedded)")
 '
 
 # Paged-KV smoke (ISSUE 10): (a) a shared-prefix workload through the

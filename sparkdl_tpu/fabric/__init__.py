@@ -1,0 +1,59 @@
+"""Multi-host serving fabric: a cache-aware router tier over per-host
+engines (ISSUE 14, ROADMAP item 1).
+
+ReplicaPool scales across the chips of ONE host; this package is the
+front door over MANY hosts — the coordinator/worker split of distributed
+TensorFlow (arXiv 1603.04467, 1603.02339) applied to the serving tier.
+Three layers, separately testable:
+
+- :mod:`~sparkdl_tpu.fabric.host` — the uniform host surface
+  (``submit/snapshot/capacity/health/prefix_digest/drain/close``):
+  :class:`InProcessHost` wraps a live engine in this process (tests,
+  the CPU harness, bench_serving's ``BENCH_HOSTS`` section) and defines
+  :data:`HOST_LEVEL_ERRORS`, the retry class for failures that indict
+  the host rather than the request.
+- :mod:`~sparkdl_tpu.fabric.http` — the thin HTTP/json transport for
+  real multi-process deployments (:class:`HostServer` over one engine,
+  :class:`HttpHostHandle` on the router side), built on the same stdlib
+  ``http.server`` machinery as the metrics exporter; remote errors
+  re-raise as the same typed exceptions the in-process engine raises.
+- :mod:`~sparkdl_tpu.fabric.router` — the :class:`Router`: weighted
+  least-outstanding-work placement with prefix-cache **affinity**
+  (hosts publish bounded prefix→host digests,
+  :mod:`~sparkdl_tpu.fabric.digest`; requests sharing a cached prefix
+  land where their blocks already live, capped so a hot prefix cannot
+  hotspot one host), sticky sessions, spillover admission control,
+  probation circuit-breaking with postmortem bundles on quarantine,
+  host-level failover, and graceful :meth:`Router.drain_host` whose
+  unstarted requests transfer queue-to-queue onto surviving hosts.
+"""
+
+from sparkdl_tpu.fabric.digest import (
+    HostDigest,
+    match_blocks,
+    prompt_block_hashes,
+)
+from sparkdl_tpu.fabric.host import (
+    HOST_LEVEL_ERRORS,
+    HostDrainingError,
+    HostHandle,
+    HostUnavailableError,
+    InProcessHost,
+)
+from sparkdl_tpu.fabric.http import HostServer, HttpHostHandle
+from sparkdl_tpu.fabric.router import AllHostsUnavailableError, Router
+
+__all__ = [
+    "AllHostsUnavailableError",
+    "HOST_LEVEL_ERRORS",
+    "HostDigest",
+    "HostDrainingError",
+    "HostHandle",
+    "HostServer",
+    "HostUnavailableError",
+    "HttpHostHandle",
+    "InProcessHost",
+    "Router",
+    "match_blocks",
+    "prompt_block_hashes",
+]

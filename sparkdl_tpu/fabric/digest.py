@@ -1,0 +1,107 @@
+"""Prefix→host digests: the state that makes routing cache-aware.
+
+The PR 10 radix prefix cache made placement a *performance* decision:
+the host that already holds a prompt's prefix blocks prefills 2.2-2.5x
+cheaper than a cold one (PERF.md), so a router that knows *where the
+blocks live* beats any load balancer on shared-prefix traffic. Shipping
+the tries themselves would be absurd; instead each host publishes a
+**digest** — the chained :func:`~sparkdl_tpu.serving.prefix_cache.chain_hash`
+values of its cached block-aligned prompt prefixes, most-recently-used
+first, bounded (``PrefixCache.block_hashes``). The router hashes an
+incoming prompt's own block-aligned prefixes ONCE
+(:func:`prompt_block_hashes`, O(L) via the same hash chain) and counts
+the longest consecutive run present in each host's digest
+(:func:`match_blocks`): that count *is* the affinity signal, in blocks.
+
+Digests are advisory, never authoritative: a stale entry (the host
+evicted the blocks since publishing) costs one cold prefill on the
+"wrong" host — exactly what a digest-less router would have paid —
+never a failure. That is why staleness degrades to plain load routing
+instead of needing consistency machinery (tested in
+tests/fabric/test_fabric_digest.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from sparkdl_tpu.serving.prefix_cache import DIGEST_ROOT, chain_hash
+
+__all__ = [
+    "HostDigest",
+    "match_blocks",
+    "prompt_block_hashes",
+]
+
+
+def prompt_block_hashes(tokens, block_size: int,
+                        max_blocks: int = 64) -> "list[int]":
+    """Chained hashes of ``tokens``' block-aligned prefixes: entry ``i``
+    covers tokens ``[0, (i+1)*block_size)``. The LAST prompt token never
+    participates (the cache holds K/V, not logits — the same
+    ``tokens[:-1]`` rule ``PrefixCache.match`` applies), so the deepest
+    hash covers at most ``len(tokens) - 1`` tokens. ``max_blocks``
+    bounds router-side work on very long prompts; affinity past 64
+    blocks adds nothing a scheduler can act on."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    usable = len(tokens) - 1  # the final token always prefills
+    out: "list[int]" = []
+    h = DIGEST_ROOT
+    for i in range(min(usable // block_size, max_blocks)):
+        h = chain_hash(
+            h, tuple(int(t)
+                     for t in tokens[i * block_size:(i + 1) * block_size]))
+        out.append(h)
+    return out
+
+
+@dataclasses.dataclass
+class HostDigest:
+    """One host's published prefix digest, as the router holds it.
+
+    ``hashes`` is the membership set; ``version`` is the host's own
+    monotonic publish counter (debugging/telemetry — the router always
+    replaces wholesale on refresh); ``fetched_at`` stamps staleness."""
+
+    host_id: str
+    block_size: int
+    hashes: frozenset
+    version: int = 0
+    fetched_at: float = dataclasses.field(default_factory=time.monotonic)
+
+    @classmethod
+    def from_snapshot(cls, snap: "dict | None") -> "HostDigest | None":
+        """Build from the dict form ``engine.prefix_digest()`` /
+        ``GET /fabric/digest`` returns (None passes through: a dense
+        host publishes no digest)."""
+        if not snap:
+            return None
+        return cls(
+            host_id=str(snap["host_id"]),
+            block_size=int(snap["block_size"]),
+            hashes=frozenset(int(h) for h in snap["hashes"]),
+            version=int(snap.get("version") or 0),
+        )
+
+    def age_s(self, now: "float | None" = None) -> float:
+        return (now if now is not None else time.monotonic()) \
+            - self.fetched_at
+
+
+def match_blocks(prompt_hashes: "list[int]",
+                 digest: "HostDigest | None") -> int:
+    """Longest CONSECUTIVE run of ``prompt_hashes`` (from the start)
+    present in ``digest`` — the estimated cached-prefix depth, in
+    blocks. Consecutive-from-zero mirrors what the radix match can
+    actually reuse: a hole at block ``i`` makes every deeper block
+    unreachable. 0 for hosts without a digest."""
+    if digest is None:
+        return 0
+    n = 0
+    for h in prompt_hashes:
+        if h not in digest.hashes:
+            break
+        n += 1
+    return n

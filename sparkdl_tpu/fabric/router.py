@@ -1,0 +1,818 @@
+"""Cache-aware router tier over per-host serving engines (ISSUE 14).
+
+ReplicaPool scales across the chips of ONE host; this router is the
+front door over MANY hosts (ROADMAP item 1, the coordinator/worker
+split of distributed TensorFlow — arXiv 1603.04467, 1603.02339 —
+applied to serving). Every placement decision folds three signals:
+
+* **Load** — weighted least-outstanding-work: the router tracks its own
+  in-flight count per host and divides by the host's capacity weight
+  (``replica_count × n_slots`` from the engine's ``capacity()``
+  structure), so a 4-replica host legitimately absorbs 4x a 1-replica
+  host's depth before looking equally busy.
+* **Affinity** — the PR 10 prefix cache made placement *stateful*: the
+  host already holding a prompt's prefix blocks prefills 2.2-2.5x
+  cheaper. Hosts publish bounded prefix→host digests
+  (:mod:`~sparkdl_tpu.fabric.digest`); the score adds
+  ``affinity_weight × min(matched_blocks, affinity_cap_blocks)`` —
+  the **cap is the anti-hotspot trade**: past ``affinity_cap_blocks``
+  of cached prefix, more affinity buys nothing, so a single hot prefix
+  cannot out-bid an arbitrarily large load imbalance and pile the
+  whole fleet's traffic on one host. Sticky **sessions** (bounded LRU
+  ``session → host`` map) keep a conversation on the host whose cache
+  holds its history without re-scoring every turn.
+* **Health** — a host answering ``unhealthy`` (its ``/healthz``-shaped
+  ``health()``), or failing ``max_failures`` consecutive submissions,
+  is quarantined behind the same probation circuit breaker ReplicaPool
+  uses: after ``probation_s`` ONE live request probes it (the rider
+  protected by the failover re-route), success rejoins, failure doubles
+  the backoff up to ``probation_max_s``.
+
+**Spillover admission control**: a host past its saturation bound
+(``max_queue_depth + n_slots`` from its capacity, or the explicit
+``max_outstanding``) is skipped even when affinity prefers it — the
+request lands on the best host WITH room (``sparkdl_fabric_spillover_total``)
+— and only an all-saturated fleet rejects (``QueueFullError``), the
+same reject-with-error backpressure the single-host queue applies.
+
+**Drain** (rolling restarts): :meth:`drain_host` stops new placements,
+extracts the host's accepted-but-unstarted requests, and re-queues them
+onto surviving hosts — in-process hosts transfer the live
+:class:`~sparkdl_tpu.serving.queue.Request` objects queue-to-queue
+(trace ids, deadlines, Futures intact; ``RequestQueue.requeue``),
+HTTP hosts fail their blocked submits with
+:class:`~sparkdl_tpu.fabric.host.HostDrainingError` and the failover
+path re-places them. In-flight requests finish on the draining host.
+
+**Failover**: a Future that fails with a *host-level* error
+(:data:`~sparkdl_tpu.fabric.host.HOST_LEVEL_ERRORS` — engine shut
+down, transport dead, draining) is re-submitted to a surviving host up
+to ``max_failovers`` times before the error reaches the caller; every
+hop lands in ``sparkdl_retries_total{site="host.submit"}`` and the
+flight ring, and a host quarantine triggers a postmortem bundle whose
+router context captures the failover sequence.
+
+Fault sites: ``router.route`` (every placement decision),
+``host.submit`` / ``host.drain`` (on the handles).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import CancelledError, Future, InvalidStateError
+from typing import Any, Iterable
+
+from sparkdl_tpu.observability import flight
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.reliability.faults import fault_point
+from sparkdl_tpu.reliability.retry import record_retry
+from sparkdl_tpu.serving.queue import QueueFullError, Request
+
+from sparkdl_tpu.fabric.digest import (
+    HostDigest,
+    match_blocks,
+    prompt_block_hashes,
+)
+from sparkdl_tpu.fabric.host import (
+    HOST_LEVEL_ERRORS,
+    HostDrainingError,
+    HostHandle,
+)
+
+__all__ = ["AllHostsUnavailableError", "Router"]
+
+_M_ROUTED = registry().counter(
+    "sparkdl_fabric_routed_total",
+    "requests the router placed, by receiving host",
+    labels=("host",))
+_M_SPILLOVER = registry().counter(
+    "sparkdl_fabric_spillover_total",
+    "placements diverted off a saturated preferred host, by the host "
+    "that absorbed them",
+    labels=("host",))
+_M_AFFINITY = registry().counter(
+    "sparkdl_fabric_affinity_hits_total",
+    "placements that landed on a host whose prefix digest matched the "
+    "prompt (cache-affine routing wins)",
+    labels=("host",))
+_M_REQUEUED = registry().counter(
+    "sparkdl_fabric_requeued_total",
+    "accepted requests re-queued off a draining or failed host onto a "
+    "surviving host")
+_M_FAILOVERS = registry().counter(
+    "sparkdl_fabric_failovers_total",
+    "requests re-submitted to another host after a host-level failure")
+_M_HOST_QUARANTINED = registry().counter(
+    "sparkdl_fabric_host_quarantined_total",
+    "hosts quarantined by the router's circuit breaker")
+_M_DIGEST_BLOCKS = registry().gauge(
+    "sparkdl_fabric_digest_blocks",
+    "prefix-digest entries the router holds per host",
+    labels=("host",))
+
+
+class AllHostsUnavailableError(RuntimeError):
+    """Every fabric host is quarantined, draining, or unhealthy and
+    none is due a probation probe; the fabric cannot place work."""
+
+
+class _Placement:
+    """One routed request's record: what the failover path needs to
+    re-submit it somewhere else."""
+
+    __slots__ = ("payload", "session", "deadline", "timeout_s",
+                 "attempts", "probe")
+
+    def __init__(self, payload, session, timeout_s):
+        self.payload = payload
+        self.session = session
+        self.timeout_s = timeout_s
+        self.deadline = (time.monotonic() + timeout_s
+                         if timeout_s is not None else None)
+        self.attempts = 0
+        self.probe = False
+
+
+class _HostState:
+    """Router-side view of one host (all mutable fields under the
+    router lock)."""
+
+    __slots__ = ("handle", "host_id", "outstanding", "routed",
+                 "consecutive_failures", "quarantined", "probing",
+                 "probation_until", "probation_backoff_s", "draining",
+                 "health_status", "digest", "weight", "saturation")
+
+    def __init__(self, handle: HostHandle, saturation: "int | None"):
+        self.handle = handle
+        self.host_id = handle.host_id
+        self.outstanding = 0
+        self.routed = 0
+        self.consecutive_failures = 0
+        self.quarantined = False
+        self.probing = False
+        self.probation_until = 0.0
+        self.probation_backoff_s = 0.0
+        self.draining = False
+        self.health_status = "ok"
+        self.digest: "HostDigest | None" = None
+        self.weight = 1
+        self.saturation = saturation if saturation is not None else 256
+
+
+class Router:
+    """Route generation requests over :class:`HostHandle` hosts.
+
+    ``submit(payload, timeout_s=, session=)`` returns a Future; payload
+    is ``{"prompt": ids, "max_new_tokens": n}`` for GPT hosts (the
+    ``prompt`` feeds affinity scoring) or an opaque feature payload for
+    micro-batching hosts. ``policy="round_robin"`` disables scoring
+    (the bench baseline); health/saturation/drain handling is identical
+    in both policies, so the comparison isolates cache-awareness.
+
+    Construct with ``auto_refresh=False`` for deterministic tests and
+    call :meth:`refresh` manually; the default refreshes digests,
+    capacity, and health every ``refresh_interval_s`` on a daemon
+    thread.
+    """
+
+    def __init__(self, hosts: "Iterable[HostHandle]", *,
+                 policy: str = "affinity",
+                 affinity_weight: float = 1.0,
+                 load_weight: float = 1.0,
+                 affinity_cap_blocks: int = 8,
+                 digest_entries: int = 1024,
+                 max_failovers: int = 2,
+                 max_failures: int = 3,
+                 probation_s: "float | None" = 1.0,
+                 probation_max_s: float = 30.0,
+                 max_outstanding: "int | None" = None,
+                 session_capacity: int = 4096,
+                 refresh_interval_s: float = 2.0,
+                 auto_refresh: bool = True):
+        if policy not in ("affinity", "round_robin"):
+            raise ValueError(
+                f"policy must be 'affinity' or 'round_robin', got "
+                f"{policy!r}")
+        if affinity_cap_blocks < 0:
+            raise ValueError(
+                f"affinity_cap_blocks must be >= 0, got "
+                f"{affinity_cap_blocks}")
+        if max_failures < 1:
+            raise ValueError(
+                f"max_failures must be >= 1, got {max_failures}")
+        if probation_s is not None and probation_s <= 0:
+            raise ValueError(
+                f"probation_s must be > 0 or None, got {probation_s}")
+        states = [_HostState(h, max_outstanding) for h in hosts]
+        if not states:
+            raise ValueError("a Router needs at least one host")
+        ids = [s.host_id for s in states]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate host ids: {sorted(ids)}")
+        self.policy = policy
+        self.affinity_weight = affinity_weight
+        self.load_weight = load_weight
+        self.affinity_cap_blocks = affinity_cap_blocks
+        self.digest_entries = digest_entries
+        self.max_failovers = max_failovers
+        self.max_failures = max_failures
+        self.probation_s = probation_s
+        self.probation_max_s = probation_max_s
+        self.max_outstanding = max_outstanding
+        self.session_capacity = session_capacity
+        self.refresh_interval_s = refresh_interval_s
+        self._hosts: "dict[str, _HostState]" = {
+            s.host_id: s for s in states}
+        self._sessions: "collections.OrderedDict[Any, str]" = \
+            collections.OrderedDict()
+        self._rr = 0
+        self._closed = False
+        self._lock = threading.Lock()
+        self._closing = threading.Event()
+        self.refresh()
+        # process-wide registrations LAST (the engine-constructor rule):
+        # /healthz and postmortem bundles read live fabric state here —
+        # the snapshot exposes replica_count/healthy_count in the pool
+        # shape healthz_report() aggregates, so an all-hosts-down fabric
+        # answers 503 at the front door
+        self._flight_name = flight.add_context_provider(
+            f"fabric-router-{id(self):x}", self.snapshot)
+        flight.record_event(
+            "fabric.start", router=self._flight_name, hosts=len(states),
+            policy=policy)
+        self._refresh_thread: "threading.Thread | None" = None
+        if auto_refresh and refresh_interval_s > 0:
+            self._refresh_thread = threading.Thread(
+                target=self._refresh_worker,
+                name="sparkdl-fabric-refresh", daemon=True)
+            self._refresh_thread.start()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, payload: Any, *, timeout_s: "float | None" = None,
+               session: Any = None) -> Future:
+        """Place one request on the best host; returns a Future that
+        survives host-level failures up to ``max_failovers`` re-routes.
+        Raises :class:`QueueFullError` when every eligible host is
+        saturated and :class:`AllHostsUnavailableError` when none is
+        eligible at all."""
+        if self._closed:
+            raise RuntimeError("Router is closed")
+        rec = _Placement(payload, session, timeout_s)
+        caller: Future = Future()
+        self._dispatch(rec, caller, exclude=None)
+        return caller
+
+    def _dispatch(self, rec: _Placement, caller: Future,
+                  exclude: "_HostState | None") -> None:
+        state = self._place(rec, exclude)
+        remaining = rec.timeout_s
+        if rec.deadline is not None:
+            remaining = max(0.001, rec.deadline - time.monotonic())
+        try:
+            inner = state.handle.submit(rec.payload, timeout_s=remaining)
+        except Exception as e:
+            reroute = (isinstance(e, QueueFullError)
+                       or isinstance(e, HOST_LEVEL_ERRORS))
+            with self._lock:
+                state.outstanding -= 1
+                if rec.probe and not reroute:
+                    # same release as the async path: a request-level
+                    # reject at the door (bad prompt) says nothing
+                    # about the host — free the probe slot
+                    state.probing = False
+            if reroute:
+                # the host refused at the door (raced saturation, drain,
+                # injected host.submit fault): same failover path as an
+                # asynchronous host failure
+                self._fail_or_reroute(rec, state, caller, e)
+                return
+            raise
+        inner.add_done_callback(
+            lambda f, rec=rec, state=state, caller=caller:
+            self._on_result(rec, state, caller, f))
+
+    def _payload_prompt(self, payload: Any):
+        if isinstance(payload, dict):
+            return payload.get("prompt")
+        return getattr(payload, "prompt", None)
+
+    def _place(self, rec: _Placement,
+               exclude: "_HostState | None", *,
+               transfer: bool = False) -> _HostState:
+        """Pick a host and charge it one outstanding unit. Handle calls
+        never happen under the router lock (deadlock discipline shared
+        with ReplicaPool). ``transfer=True`` is the drain-transfer
+        placement: quarantined hosts are out entirely (a transfer
+        bypasses the router's completion callbacks, so it can neither
+        release a probe slot nor survive landing in a dead host's
+        queue) and saturation does NOT reject — the requests were
+        already accepted, and the target queue's cross-queue ``requeue``
+        absorbs transfers past ``max_depth`` by contract."""
+        fault_point("router.route")
+        prompt = (self._payload_prompt(rec.payload)
+                  if self.policy == "affinity" else None)
+        # hash outside the lock (pure CPU work); one digest grid per
+        # distinct block size in the fleet (normally exactly one)
+        hashes_by_bs: "dict[int, list[int]]" = {}
+        if prompt is not None:
+            with self._lock:
+                sizes = {s.digest.block_size
+                         for s in self._hosts.values()
+                         if s.digest is not None}
+            hashes_by_bs = {
+                bs: prompt_block_hashes(prompt, bs,
+                                        self.affinity_cap_blocks)
+                for bs in sizes}
+        spilled = False
+        affine = False
+        probe = False
+        chosen: "_HostState | None" = None
+        with self._lock:
+            now = time.monotonic()
+            candidates = [
+                s for s in self._hosts.values()
+                if s is not exclude and not s.draining
+                and s.health_status not in ("unhealthy", "unreachable")
+                and (not s.quarantined
+                     or (not transfer
+                         and self._probe_due_locked(s, now)))
+            ]
+            if candidates:
+                chosen = self._sticky_locked(rec, candidates)
+                if chosen is None:
+                    chosen, spilled, affine = self._score_locked(
+                        rec, candidates, hashes_by_bs,
+                        include_saturated=transfer)
+                if chosen.quarantined:
+                    chosen.probing = True
+                    probe = True
+                chosen.outstanding += 1
+                chosen.routed += 1
+                if rec.session is not None:
+                    self._sessions[rec.session] = chosen.host_id
+                    self._sessions.move_to_end(rec.session)
+                    while len(self._sessions) > self.session_capacity:
+                        self._sessions.popitem(last=False)
+        if chosen is None:
+            # event + postmortem trigger outside the lock (the dump's
+            # providers call snapshot(), which takes it again)
+            flight.record_event(
+                "fabric.no_hosts", hosts=len(self._hosts))
+            flight.trigger_dump("fabric_unavailable")
+            raise AllHostsUnavailableError(
+                f"none of the {len(self._hosts)} fabric hosts can "
+                "take work (quarantined/draining/unhealthy)")
+        if probe:
+            rec.probe = True
+        _M_ROUTED.inc(host=chosen.host_id)
+        if spilled:
+            _M_SPILLOVER.inc(host=chosen.host_id)
+        if affine:
+            _M_AFFINITY.inc(host=chosen.host_id)
+        return chosen
+
+    def _probe_due_locked(self, s: _HostState, now: float) -> bool:
+        return (self.probation_s is not None and not s.probing
+                and now >= s.probation_until)
+
+    def _sticky_locked(self, rec: _Placement,
+                       candidates: "list[_HostState]"
+                       ) -> "_HostState | None":
+        """A continuing session lands on its remembered host when that
+        host is still eligible AND has room — its cache holds the
+        session's history, the strongest affinity signal there is.
+        First placements and broken stickiness fall through to
+        scoring."""
+        if rec.session is None or rec.attempts:
+            return None
+        host_id = self._sessions.get(rec.session)
+        if host_id is None:
+            return None
+        for s in candidates:
+            if (s.host_id == host_id and not s.quarantined
+                    and s.outstanding < s.saturation):
+                return s
+        return None
+
+    def _score_locked(self, rec: _Placement,
+                      candidates: "list[_HostState]",
+                      hashes_by_bs: "dict[int, list[int]]",
+                      include_saturated: bool = False
+                      ) -> "tuple[_HostState, bool, bool]":
+        """(chosen, spilled, affine): affinity-bonus minus load-penalty
+        over the non-saturated candidates; ``spilled`` reports that a
+        saturated host would have scored best (spillover admission
+        control diverted the request). ``include_saturated`` (drain
+        transfers) scores every candidate — already-accepted traffic is
+        never re-rejected."""
+        def bonus(s: _HostState) -> int:
+            if not hashes_by_bs or s.digest is None:
+                return 0
+            # .get: a refresh may have swapped in a digest with a block
+            # size unseen when the prompt was hashed (pre-lock) — worth
+            # zero affinity this placement, correct next one
+            hashes = hashes_by_bs.get(s.digest.block_size)
+            if hashes is None:
+                return 0
+            hit = match_blocks(hashes, s.digest)
+            return min(hit, self.affinity_cap_blocks)
+
+        open_hosts = (list(candidates) if include_saturated
+                      else [s for s in candidates
+                            if s.outstanding < s.saturation])
+        if not open_hosts:
+            raise QueueFullError(
+                f"all {len(candidates)} eligible fabric hosts are "
+                "saturated; retry with backoff or add hosts")
+        if self.policy == "round_robin":
+            chosen = open_hosts[self._rr % len(open_hosts)]
+            self._rr += 1
+            return chosen, False, False
+        # score each host exactly once (nothing can change under the
+        # held lock): the digest walks are the lock's hot-path cost
+        bonuses = {s.host_id: bonus(s) for s in candidates}
+        scores = {
+            s.host_id: (self.affinity_weight * bonuses[s.host_id]
+                        - self.load_weight * s.outstanding / s.weight)
+            for s in candidates}
+        best_score = max(scores[s.host_id] for s in open_hosts)
+        ties = [s for s in open_hosts if scores[s.host_id] == best_score]
+        chosen = ties[self._rr % len(ties)]
+        self._rr += 1
+        # spillover: a saturated host would have outscored the choice
+        spilled = max(scores.values()) > best_score
+        return chosen, spilled, bonuses[chosen.host_id] > 0
+
+    # -- completion / failover (runs on host worker threads) -----------------
+    @staticmethod
+    def _resolve_caller(caller: Future, *, result: Any = None,
+                        exc: "BaseException | None" = None) -> None:
+        """Resolve the caller-facing Future, tolerating a caller that
+        cancelled it while the work was in flight (the router never
+        marks it RUNNING, so cancel() can win any time before this; the
+        result is simply dropped — the work already happened)."""
+        try:
+            if exc is not None:
+                caller.set_exception(exc)
+            else:
+                caller.set_result(result)
+        except InvalidStateError:
+            pass
+
+    def _on_result(self, rec: _Placement, state: _HostState,
+                   caller: Future, fut: Future) -> None:
+        try:
+            self._on_result_inner(rec, state, caller, fut)
+        except Exception as e:  # a hung caller Future is worse than
+            self._resolve_caller(caller, exc=e)  # any error it carries
+
+    def _on_result_inner(self, rec: _Placement, state: _HostState,
+                         caller: Future, fut: Future) -> None:
+        exc = (CancelledError("host cancelled the request")
+               if fut.cancelled() else fut.exception())
+        if exc is None:
+            rejoined = False
+            with self._lock:
+                state.outstanding -= 1
+                state.consecutive_failures = 0
+                state.probing = False
+                if self.probation_s is not None:
+                    state.probation_backoff_s = self.probation_s
+                if state.quarantined:
+                    state.quarantined = False
+                    rejoined = True
+            if rejoined:
+                flight.record_event(
+                    "fabric.host_reintegrated", host=state.host_id)
+            if rec.attempts:
+                record_retry("host.submit", "recovered")
+            self._resolve_caller(caller, result=fut.result())
+            return
+        with self._lock:
+            state.outstanding -= 1
+            if rec.probe and not isinstance(exc, HOST_LEVEL_ERRORS):
+                # the probe's request failed for its own reasons
+                # (deadline on the recovering host's queue, bad
+                # prompt): inconclusive about the HOST — release the
+                # probe slot so the next due probe can run, else the
+                # host stays quarantined forever
+                state.probing = False
+        if isinstance(exc, HOST_LEVEL_ERRORS):
+            self._fail_or_reroute(rec, state, caller, exc)
+        else:
+            # the request's own outcome (deadline, bad prompt, model
+            # error): pass through exactly once — the host already
+            # accounted it
+            self._resolve_caller(caller, exc=exc)
+
+    def _fail_or_reroute(self, rec: _Placement, state: _HostState,
+                         caller: Future, exc: BaseException) -> None:
+        if not isinstance(exc, (HostDrainingError, QueueFullError)):
+            # a drain or a full queue is planned backpressure, not a
+            # host failure — only real failures feed the breaker
+            self._record_host_failure(state, exc)
+        elif rec.probe:
+            self._record_host_failure(state, exc)
+        expired = (rec.deadline is not None
+                   and time.monotonic() >= rec.deadline)
+        if rec.attempts < self.max_failovers and not expired:
+            rec.attempts += 1
+            rec.probe = False
+            _M_FAILOVERS.inc()
+            record_retry("host.submit", "retried")
+            flight.record_event(
+                "fabric.failover", host=state.host_id,
+                attempt=rec.attempts, error=type(exc).__name__)
+            try:
+                self._dispatch(rec, caller, exclude=state)
+                return
+            except Exception as e:
+                record_retry("host.submit", "exhausted")
+                self._resolve_caller(caller, exc=e)
+                return
+        if self.max_failovers:
+            record_retry("host.submit", "exhausted")
+        self._resolve_caller(caller, exc=exc)
+
+    def _record_host_failure(self, state: _HostState,
+                             exc: BaseException) -> None:
+        quarantined_now = False
+        probe_failed = False
+        with self._lock:
+            now = time.monotonic()
+            if state.probing and state.quarantined:
+                # failed probation probe: stay out, back off harder
+                state.probing = False
+                state.probation_backoff_s = min(
+                    state.probation_backoff_s * 2.0,
+                    self.probation_max_s)
+                state.probation_until = now + state.probation_backoff_s
+                probe_failed = True
+            else:
+                state.probing = False
+                state.consecutive_failures += 1
+                if (state.consecutive_failures >= self.max_failures
+                        and not state.quarantined):
+                    state.quarantined = True
+                    if self.probation_s is not None:
+                        state.probation_backoff_s = self.probation_s
+                        state.probation_until = now + self.probation_s
+                    quarantined_now = True
+        if probe_failed:
+            flight.record_event(
+                "fabric.probe_failed", host=state.host_id,
+                next_probe_s=round(state.probation_backoff_s, 3),
+                error=type(exc).__name__)
+        if quarantined_now:
+            _M_HOST_QUARANTINED.inc()
+            # event + postmortem OUTSIDE the lock: the dump's providers
+            # call snapshot(), which takes it again
+            flight.record_event(
+                "fabric.host_quarantined", host=state.host_id,
+                failures=state.consecutive_failures,
+                error=type(exc).__name__)
+            flight.trigger_dump("host_failover", host=state.host_id)
+
+    # -- refresh (digests, capacity, health) ---------------------------------
+    def refresh(self) -> None:
+        """Pull every host's capacity/digest/health once (handle calls
+        outside the router lock). The auto-refresh thread calls this on
+        its cadence; tests call it manually after seeding caches."""
+        for state in list(self._hosts.values()):
+            try:
+                cap = state.handle.capacity()
+                digest = HostDigest.from_snapshot(
+                    state.handle.prefix_digest(self.digest_entries))
+                health = state.handle.health()
+            except Exception as e:
+                with self._lock:
+                    state.health_status = "unreachable"
+                flight.record_event(
+                    "fabric.refresh_failed", host=state.host_id,
+                    error=type(e).__name__)
+                continue
+            weight = (max(1, int(cap.get("replica_count") or 1))
+                      * max(1, int(cap.get("n_slots") or 1)))
+            saturation = self.max_outstanding
+            if saturation is None:
+                saturation = (int(cap.get("max_queue_depth") or 256)
+                              + int(cap.get("n_slots") or 0))
+            with self._lock:
+                state.weight = weight
+                state.saturation = saturation
+                state.digest = digest
+                state.health_status = str(
+                    health.get("status") or "ok")
+            _M_DIGEST_BLOCKS.set(
+                len(digest.hashes) if digest is not None else 0,
+                host=state.host_id)
+
+    def _refresh_worker(self) -> None:
+        while not self._closing.wait(self.refresh_interval_s):
+            try:
+                self.refresh()
+            except Exception:  # pragma: no cover - observability guard
+                flight.record_event("fabric.refresh_error")
+
+    # -- drain / lifecycle ---------------------------------------------------
+    def drain_host(self, host_id: str, *,
+                   wait_s: "float | None" = None) -> int:
+        """Gracefully drain one host for a rolling restart: no new
+        placements, unstarted requests re-queued onto surviving hosts
+        (queue-level :class:`Request` transfer for in-process hosts —
+        trace ids/deadlines/Futures intact; transport hosts fail their
+        blocked submits with :class:`HostDrainingError` and the
+        failover path re-places them), in-flight requests finish where
+        they are. Returns the number of requests re-queued. ``wait_s``
+        blocks (bounded) until the router sees zero outstanding work on
+        the host."""
+        state = self._hosts.get(host_id)
+        if state is None:
+            raise KeyError(f"unknown fabric host {host_id!r}")
+        with self._lock:
+            state.draining = True
+            for k in [k for k, v in self._sessions.items()
+                      if v == host_id]:
+                del self._sessions[k]
+        flight.record_event("fabric.drain_begin", host=host_id)
+        try:
+            reqs = state.handle.drain()
+        except Exception as e:
+            # one retry: a drain interrupted by a transient (or an
+            # injected host.drain fault) must not strand the host
+            # half-drained
+            record_retry("host.drain", "retried")
+            try:
+                reqs = state.handle.drain()
+            except Exception:
+                record_retry("host.drain", "exhausted")
+                raise
+            record_retry("host.drain", "recovered")
+            flight.record_event(
+                "fabric.drain_retried", host=host_id,
+                error=type(e).__name__)
+        moved = self._requeue_requests(reqs)
+        flight.record_event(
+            "fabric.drain_requeued", host=host_id, requeued=moved)
+        if wait_s is not None:
+            deadline = time.monotonic() + wait_s
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if state.outstanding <= 0:
+                        break
+                time.sleep(0.01)
+        return moved
+
+    def _requeue_requests(self, reqs: "list[Request]") -> int:
+        """Hand drained :class:`Request` objects to surviving hosts:
+        queue-level transfer where the target is in-process (the
+        ``RequestQueue.requeue`` cross-queue contract), submit-and-
+        bridge where it is remote. Requests that cannot be placed
+        anywhere fail with the placement error — counted once, by this
+        final owner."""
+        if not reqs:
+            return 0
+        per_target: "dict[str, list[Request]]" = {}
+        moved = 0
+        for req in reqs:
+            rec = _Placement(req.payload, None, None)
+            rec.deadline = req.deadline
+            try:
+                state = self._place(rec, exclude=None, transfer=True)
+            except Exception as e:
+                self._fail_transferred(req, e)
+                continue
+            if hasattr(state.handle, "requeue"):
+                per_target.setdefault(state.host_id, []).append(req)
+                # the engine owns it now; the router's outstanding
+                # charge from _place would never be repaid
+                with self._lock:
+                    state.outstanding -= 1
+            else:
+                try:
+                    self._bridge_transfer(req, rec, state)
+                except Exception as e:
+                    # the surviving host refused at the door (raced
+                    # drain/close): repay the charge, count the loss
+                    # once, here — its final owner
+                    with self._lock:
+                        state.outstanding -= 1
+                    self._fail_transferred(req, e)
+                    continue
+            moved += 1
+        for host_id, batch in per_target.items():
+            self._hosts[host_id].handle.requeue(batch)
+            flight.record_event(
+                "fabric.requeued", host=host_id, requests=len(batch),
+                request_ids=[r.request_id for r in batch])
+        if moved:
+            _M_REQUEUED.inc(moved)
+        return moved
+
+    def _fail_transferred(self, req: Request, exc: BaseException) -> None:
+        """A drained request that could not be re-placed anywhere dies
+        here, counted exactly once (its original host recorded nothing —
+        the no-double-count contract)."""
+        from sparkdl_tpu.serving.queue import record_request_failure
+
+        if req.started or req.future.set_running_or_notify_cancel():
+            record_request_failure(exc, request_id=req.request_id)
+            try:
+                req.future.set_exception(exc)
+            except InvalidStateError:
+                pass
+
+    def _bridge_transfer(self, req: Request, rec: _Placement,
+                         state: _HostState) -> None:
+        """Re-place one drained request on a remote host by submitting
+        its payload and forwarding the result into the original
+        Future (the transfer form queue-level requeue cannot reach)."""
+        remaining = None
+        if req.deadline is not None:
+            remaining = max(0.001, req.deadline - time.monotonic())
+        payload = req.payload
+        if not isinstance(payload, dict):
+            payload = {"prompt": payload.prompt,
+                       "max_new_tokens": payload.max_new_tokens}
+        inner = state.handle.submit(payload, timeout_s=remaining)
+        if not req.started:
+            req.future.set_running_or_notify_cancel()
+            req.started = True
+
+        def forward(f, req=req, state=state):
+            with self._lock:
+                state.outstanding -= 1
+            exc = (CancelledError("host cancelled the request")
+                   if f.cancelled() else f.exception())
+            if exc is None:
+                try:
+                    req.future.set_result(f.result())
+                except InvalidStateError:
+                    pass
+            else:
+                self._fail_transferred(req, exc)
+
+        inner.add_done_callback(forward)
+
+    def hosts(self) -> "list[str]":
+        return list(self._hosts)
+
+    def snapshot(self) -> "dict[str, Any]":
+        """Operator/postmortem view. Exposes ``replica_count`` /
+        ``healthy_count`` in the pool shape ``healthz_report()``
+        aggregates — the fabric's hosts ARE this tier's replicas, so an
+        all-hosts-down fabric degrades /healthz to unhealthy exactly
+        like a dead replica pool would."""
+        with self._lock:
+            hosts = [
+                {
+                    "host": s.host_id,
+                    "outstanding": s.outstanding,
+                    "routed": s.routed,
+                    "weight": s.weight,
+                    "saturation": s.saturation,
+                    "quarantined": s.quarantined,
+                    "draining": s.draining,
+                    "health": s.health_status,
+                    "consecutive_failures": s.consecutive_failures,
+                    "digest_blocks": (len(s.digest.hashes)
+                                      if s.digest is not None else 0),
+                    "digest_age_s": (round(s.digest.age_s(), 3)
+                                     if s.digest is not None else None),
+                }
+                for s in self._hosts.values()
+            ]
+            sessions = len(self._sessions)
+        healthy = sum(
+            not h["quarantined"] and not h["draining"]
+            and h["health"] not in ("unhealthy", "unreachable")
+            for h in hosts)
+        return {
+            "policy": self.policy,
+            "replica_count": len(hosts),
+            "healthy_count": healthy,
+            "hosts": hosts,
+            "sessions": sessions,
+        }
+
+    def close(self) -> None:
+        """Stop the router (refresh thread, registrations). Hosts are
+        NOT closed — the caller owns their lifecycle (a router restart
+        must not restart the fleet)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._closing.set()
+        if self._refresh_thread is not None:
+            self._refresh_thread.join(timeout=5)
+        flight.record_event("fabric.close", router=self._flight_name)
+        flight.remove_context_provider(self._flight_name)
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

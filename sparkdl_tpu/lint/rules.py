@@ -944,6 +944,10 @@ ENV_ALLOWLIST = {
                                 "first use",
     "SPARKDL_TPU_TRACE": "tracing on/off switch, read at import",
     "SPARKDL_TPU_METRICS_PORT": "exporter opt-in, read at server start",
+    "SPARKDL_TPU_HOST_ID": "fabric host identity (a k8s pod name), "
+                           "read by default_host_id() at engine "
+                           "construction — infra identity, not a "
+                           "tunable knob",
     "SPARKDL_TPU_PROFILE": "bench profiling switch",
     "SPARKDL_TPU_PROFILE_DIR": "bench profiling output dir",
     "SPARKDL_TPU_PROFILE_HZ": "bench profiling sample rate",

@@ -77,6 +77,9 @@ KNOWN_SITES = (
     "spec.verify",
     "sp.permute",
     "sp.gather",
+    "router.route",
+    "host.submit",
+    "host.drain",
     "worker.rank",
 )
 

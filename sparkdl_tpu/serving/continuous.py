@@ -303,6 +303,7 @@ class ContinuousGPTEngine:
                  kv_dtype: str = "fp32",
                  metrics: ServingMetrics | None = None,
                  slo: "slo_mod.SLO | None" = None,
+                 host_id: "str | None" = None,
                  auto_start: bool = True):
         import jax
         import jax.numpy as jnp
@@ -357,8 +358,15 @@ class ContinuousGPTEngine:
                 f"max_len {max_len} exceeds the learned position table "
                 f"(max_seq_len={config.max_seq_len})"
             )
+        from sparkdl_tpu.serving.metrics import default_host_id
+
         self.config = config
         self.variables = variables
+        #: stable host identity for the fabric's router tier (ISSUE 14):
+        #: snapshot()/capacity are keyed by it, the prefix digest names
+        #: it, and SPARKDL_TPU_HOST_ID pins it per process
+        self.host_id = host_id if host_id is not None else default_host_id()
+        self._digest_seq = 0
         self.n_slots = n_slots
         self.max_len = max_len
         self.eos_id = eos_id
@@ -1060,6 +1068,43 @@ class ContinuousGPTEngine:
             self._pool.close()
             if self.sp > 1:
                 self._sp_pool.close()
+
+    def begin_drain(self) -> "list[Request]":
+        """Graceful host drain, phase one (ISSUE 14): stop admission and
+        hand back every request that was accepted but NOT yet placed in
+        a slot — the fabric re-queues them onto surviving hosts
+        (``RequestQueue.requeue`` on the target; trace ids, deadlines,
+        and Futures ride the returned :class:`Request` objects
+        untouched). Requests already prefilling/decoding are NOT
+        returned: they finish here — the engine loop exits on its own
+        once the last one retires, after which :meth:`close` joins
+        instantly. Idempotent-ish: a second call returns []."""
+        self.queue.close()
+        reqs = self.queue.extract_pending()
+        flight_mod.record_event(
+            "engine.drain_begin", engine=getattr(self._obs, "name", None),
+            host=self.host_id, extracted=len(reqs),
+            inflight=len(self._inflight) + len(self._prefilling))
+        return reqs
+
+    def prefix_digest(self, max_entries: int = 1024) -> "dict | None":
+        """The compact prefix→host digest this host publishes
+        (ISSUE 14): chained hashes of its cached block-aligned prompt
+        prefixes, most-recently-used first, bounded. A router matches an
+        incoming prompt's own block hashes against these to estimate
+        how many prefill blocks this host already holds. None under the
+        dense layout (no prefix cache — nothing to be affine to)."""
+        if self.kv_layout != "paged":
+            return None
+        with self._lock:
+            hashes = self._prefix.block_hashes(max_entries)
+            self._digest_seq += 1
+            return {
+                "host_id": self.host_id,
+                "block_size": self._kv_bs,
+                "version": self._digest_seq,
+                "hashes": hashes,
+            }
 
     def _loop(self) -> None:
         try:
@@ -2018,8 +2063,29 @@ class ContinuousGPTEngine:
             out["spec"] = spec
         return out
 
+    def capacity(self) -> "dict[str, Any]":
+        """The one structure a router's weighting reads (ISSUE 14):
+        identity + room, instead of poking queue, pool, and slot state
+        separately. Best-effort reads (no engine lock): routing weights
+        tolerate a tick of staleness."""
+        paged = self.kv_layout == "paged"
+        return {
+            "host_id": self.host_id,
+            "replica_count": 1,
+            "n_slots": self.n_slots,
+            "free_slots": (self.n_slots - len(self._inflight)
+                           - len(self._prefilling)),
+            "kv_blocks_free": self._pool.free_count if paged else None,
+            "kv_blocks_total": self._pool.n_blocks if paged else None,
+            "queue_depth": self.queue.depth,
+            "max_queue_depth": self.queue.max_depth,
+            "draining": self.queue.closed,
+        }
+
     def snapshot(self) -> dict[str, Any]:
         out = self.metrics.snapshot(self.queue)
+        out["host_id"] = self.host_id
+        out["capacity"] = self.capacity()
         out["active_slots"] = self.active_slots
         out["n_slots"] = self.n_slots
         out["kv_layout"] = self.kv_layout

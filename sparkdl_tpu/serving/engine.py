@@ -59,11 +59,16 @@ class ServingEngine:
                  max_wait_s: float = 0.005,
                  extract: Callable[[Any], dict[str, np.ndarray]] | None = None,
                  metrics: ServingMetrics | None = None,
-                 slo: "slo_mod.SLO | None" = None):
+                 slo: "slo_mod.SLO | None" = None,
+                 host_id: "str | None" = None):
+        from sparkdl_tpu.serving.metrics import default_host_id
+
         # Opt-in observability endpoint (SPARKDL_TPU_METRICS_PORT):
         # idempotent, so every engine in the process shares one server.
         maybe_start_metrics_server()
         self.runner = runner
+        #: stable host identity for the fabric's router tier (ISSUE 14)
+        self.host_id = host_id if host_id is not None else default_host_id()
         self.queue = RequestQueue(max_depth=max_queue_depth)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.batcher = MicroBatcher(
@@ -102,6 +107,52 @@ class ServingEngine:
         return (self.queue.pending_request_ids()
                 + self.batcher.inflight_request_ids())
 
+    def begin_drain(self):
+        """Graceful host drain, phase one (ISSUE 14): stop admission and
+        hand back every accepted-but-undispatched request (the fabric
+        re-queues them to surviving hosts via ``RequestQueue.requeue``
+        on the target — Futures, trace ids, and deadlines untouched).
+        Batches already dispatched finish here; :meth:`close` afterwards
+        completes the drain."""
+        from sparkdl_tpu.observability import flight
+
+        self.queue.close()
+        reqs = self.queue.extract_pending()
+        flight.record_event(
+            "engine.drain_begin", engine=self._obs.name,
+            host=self.host_id, extracted=len(reqs))
+        return reqs
+
+    def capacity(self, _pool_snap: "dict | None" = None) -> dict:
+        """The one structure a router's weighting reads (ISSUE 14):
+        identity + room. ``n_slots``/KV fields are None — this engine
+        has no persistent decode slots or block pool; its weight is its
+        replica count. ``_pool_snap`` lets :meth:`snapshot` share the
+        pool snapshot it already fetched (walking per-replica state
+        twice per router poll would be pure waste)."""
+        if _pool_snap is None:
+            pool_snapshot = getattr(self.runner, "snapshot", None)
+            _pool_snap = (pool_snapshot()
+                          if callable(pool_snapshot) else {})
+        replicas = _pool_snap.get("replica_count", 1)
+        return {
+            "host_id": self.host_id,
+            "replica_count": replicas,
+            "n_slots": None,
+            "free_slots": None,
+            "kv_blocks_free": None,
+            "kv_blocks_total": None,
+            "queue_depth": self.queue.depth,
+            "max_queue_depth": self.queue.max_depth,
+            "draining": self.queue.closed,
+        }
+
+    def prefix_digest(self, max_entries: int = 1024) -> "dict | None":
+        """No prefix cache on the micro-batching engine: routing to it
+        is pure load balancing (the fabric's digest surface is uniform
+        across host kinds, so the router never special-cases)."""
+        return None
+
     def close(self, *, drain: bool = True,
               timeout_s: float | None = 30.0) -> None:
         self.batcher.shutdown(drain=drain, timeout_s=timeout_s)
@@ -124,9 +175,12 @@ class ServingEngine:
         and rolling SLO compliance/burn under ``slo`` when objectives
         were declared."""
         snap = self.metrics.snapshot(self.queue)
+        snap["host_id"] = self.host_id
         pool_snapshot = getattr(self.runner, "snapshot", None)
-        if callable(pool_snapshot):
-            snap.update(pool_snapshot())
+        pool_snap = pool_snapshot() if callable(pool_snapshot) else None
+        snap["capacity"] = self.capacity(_pool_snap=pool_snap or {})
+        if pool_snap is not None:
+            snap.update(pool_snap)
         else:
             snap["replica_count"] = 1
         from sparkdl_tpu.observability.registry import registry

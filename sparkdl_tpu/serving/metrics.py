@@ -8,6 +8,8 @@ whole stack), and counters mirror the queue's admission bookkeeping.
 
 from __future__ import annotations
 
+import os
+import socket
 import threading
 from typing import Any
 
@@ -30,6 +32,18 @@ _M_BATCHES = registry().counter(
 _M_OCCUPANCY = registry().histogram(
     "sparkdl_serving_batch_occupancy_pct",
     "live rows per dispatch as % of capacity", buckets=PERCENT_BUCKETS)
+
+
+def default_host_id() -> str:
+    """The stable id a serving engine publishes in ``snapshot()`` so a
+    router tier can address this host (ISSUE 14). Operators pin it via
+    ``SPARKDL_TPU_HOST_ID`` (a k8s pod name, an instance id); the
+    default ``hostname:pid`` is unique per serving process, which is
+    what the fabric's in-process test hosts and single-host deployments
+    need. Engines may also take ``host_id=`` directly (how several
+    in-process hosts in one test process stay distinct)."""
+    env = os.environ.get("SPARKDL_TPU_HOST_ID")
+    return env if env else f"{socket.gethostname()}:{os.getpid()}"
 
 
 class EngineObservability:

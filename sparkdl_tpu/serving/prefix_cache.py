@@ -35,6 +35,7 @@ vs prefilled, ``sparkdl_prefix_evictions_total`` counts blocks evicted.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import itertools
 from typing import Any, Optional
 
@@ -50,6 +51,27 @@ _M_MISSES = registry().counter(
 _M_EVICTIONS = registry().counter(
     "sparkdl_prefix_evictions_total",
     "cached prefix blocks evicted (LRU, refcount-0 leaves)")
+
+
+#: chain_hash root: the hash of the empty prefix (any fixed value works;
+#: it only needs to agree across hosts, which a constant guarantees)
+DIGEST_ROOT = 0
+
+
+def chain_hash(parent: int, tokens: "tuple[int, ...]") -> int:
+    """Stable hash of one more block of prefix tokens chained onto the
+    parent prefix's hash — the prefix→host digest entry (ISSUE 14).
+
+    Chaining makes hashing a prompt's every block-aligned prefix O(L)
+    instead of O(L²/bs), and ``blake2b`` (not Python ``hash``) keeps the
+    value identical across processes and hosts regardless of
+    ``PYTHONHASHSEED`` — the property that lets a router compare a local
+    prompt's hashes against digests other hosts published."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(int(parent).to_bytes(8, "little", signed=False))
+    for t in tokens:
+        h.update(int(t).to_bytes(4, "little", signed=True))
+    return int.from_bytes(h.digest(), "little")
 
 
 @dataclasses.dataclass
@@ -200,6 +222,33 @@ class PrefixCache:
                 out.extend(best.tokens[len(rest):])
             break
         return out[:k]
+
+    def block_hashes(self, max_entries: int = 1024) -> "list[int]":
+        """Chained :func:`chain_hash` values of the cached block-aligned
+        prefixes — the compact digest a host publishes so a router can
+        place requests where their prefix blocks already live
+        (ISSUE 14). Most-recently-used first, capped at ``max_entries``
+        (a bounded digest stays cheap to ship and compare; evicting the
+        coldest entries first mirrors what the LRU eviction would drop
+        anyway). Partial tail blocks are excluded: the digest is
+        block-aligned by construction, matching the router-side
+        :func:`~sparkdl_tpu.fabric.digest.prompt_block_hashes` grid.
+        Call under the engine lock (same discipline as every other trie
+        walk)."""
+        if max_entries < 1:
+            return []
+        entries: "list[tuple[int, int]]" = []
+        stack: "list[tuple[_Node, int]]" = [
+            (child, chain_hash(DIGEST_ROOT, key))
+            for key, child in self._root.children.items()
+        ]
+        while stack:
+            node, h = stack.pop()
+            entries.append((node.stamp, h))
+            for key, child in node.children.items():
+                stack.append((child, chain_hash(h, key)))
+        entries.sort(reverse=True)
+        return [h for _, h in entries[:max_entries]]
 
     def record_lookup(self, hit_tokens: int, miss_tokens: int) -> None:
         """Land one admission's hit/miss split (prompt tokens) in the

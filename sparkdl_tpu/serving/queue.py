@@ -330,7 +330,21 @@ class RequestQueue:
         the KV block pool is exhausted). They are retaken ahead of
         everything submitted after them, so deferral never reorders
         accepted traffic. Works on a closed queue: the requests were
-        admitted before close() and close keeps queued work takeable."""
+        admitted before close() and close keeps queued work takeable.
+
+        The requests need not have come from THIS queue: a drained or
+        failed host's unstarted requests (``extract_pending`` on the
+        dying queue) are handed to a surviving queue through this same
+        call — the :class:`Request` carries its trace id, absolute
+        deadline, and ``started`` flag, so nothing about the request's
+        identity or accounting resets on transfer. The transfer itself
+        is NOT a failure: no Future is touched and nothing lands in
+        ``sparkdl_requests_failed_total`` — if the re-routed request
+        later fails it is counted once, by its new owner (and if it
+        succeeds, it was never counted at all). A transfer may
+        transiently push this queue past ``max_depth`` (bounded by the
+        dying queue's depth); admission control applies to NEW submits
+        only — already-accepted traffic is never re-rejected."""
         if not requests:
             return
         with self._cv:
@@ -340,6 +354,23 @@ class RequestQueue:
             _M_REQUEUED.inc(len(requests))
             self._update_depth_locked()
             self._cv.notify_all()
+
+    def extract_pending(self) -> "list[Request]":
+        """Remove and return every queued request WITHOUT resolving its
+        Future — the drain/transfer primitive (ISSUE 14): a draining or
+        dying host extracts its not-yet-placed requests here and hands
+        them to a surviving host's queue via :meth:`requeue`. Futures,
+        trace ids, deadlines, and ``started`` flags ride along
+        untouched, and nothing is recorded as failed — the requests are
+        moving, not dying. Deferred requests (``started=True``, taken
+        once then re-queued on pool exhaustion) are included: they hold
+        no device state, so they transfer as cleanly as fresh ones.
+        Call after :meth:`close` so no new submit races the drain."""
+        with self._cv:
+            out = list(self._dq)
+            self._dq.clear()
+            self._update_depth_locked()
+        return out
 
     def sweep_expired(self) -> None:
         """Fail every expired queued request now. take() sweeps anyway;

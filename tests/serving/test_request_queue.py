@@ -224,3 +224,94 @@ class TestFailureReasons:
             # and once closed, submit ALWAYS raises
             with pytest.raises(EngineClosedError):
                 q.submit("late")
+
+
+class TestCrossQueueTransfer:
+    """ISSUE 14 satellite: drained/failed-host unstarted requests hand
+    off to a *different* queue — identity (trace id, deadline, Future,
+    started flag) rides along, and the move itself is never counted as
+    a failure (no double-count when the re-routed request later
+    succeeds)."""
+
+    @staticmethod
+    def _failed_total():
+        from sparkdl_tpu.observability.registry import registry
+
+        fam = registry().get("sparkdl_requests_failed_total")
+        return sum(fam.snapshot_values().values()) if fam else 0.0
+
+    def test_extract_pending_preserves_identity(self):
+        src = RequestQueue(max_depth=8)
+        before = self._failed_total()
+        f1 = src.submit("a", timeout_s=30.0)
+        f2 = src.submit("b")
+        src.close()
+        reqs = src.extract_pending()
+        assert src.depth == 0
+        assert [r.payload for r in reqs] == ["a", "b"]
+        assert [r.future for r in reqs] == [f1, f2]
+        assert reqs[0].request_id == f1.request_id
+        assert reqs[0].deadline is not None
+        assert reqs[1].deadline is None
+        # nothing resolved, nothing counted: the requests are MOVING
+        assert not f1.done() and not f2.done()
+        assert self._failed_total() == before
+        assert src.extract_pending() == []  # second call: empty
+
+    def test_requeue_into_foreign_queue_fifo_ahead(self):
+        src, dst = RequestQueue(max_depth=8), RequestQueue(max_depth=8)
+        fd = dst.submit("resident")
+        fa = src.submit("moved-1")
+        fb = src.submit("moved-2")
+        src.close()
+        dst.requeue(src.extract_pending())
+        assert dst.depth == 3
+        taken = dst.take(3, 0.0)
+        # transfers land at the head, in order: accepted-before beats
+        # submitted-after on the surviving queue too
+        assert [r.payload for r in taken] == [
+            "moved-1", "moved-2", "resident"]
+        assert [r.future for r in taken] == [fa, fb, fd]
+
+    def test_transfer_may_exceed_max_depth_but_new_submits_reject(self):
+        src = RequestQueue(max_depth=4)
+        dst = RequestQueue(max_depth=2)
+        for i in range(2):
+            dst.submit(f"d{i}")
+        for i in range(3):
+            src.submit(f"s{i}")
+        src.close()
+        dst.requeue(src.extract_pending())
+        assert dst.depth == 5  # already-accepted traffic never re-rejected
+        with pytest.raises(QueueFullError):
+            dst.submit("new")  # admission control still bites NEW work
+        assert len(dst.take(16, 0.0)) == 5
+
+    def test_transferred_deferred_request_keeps_started_flag(self):
+        """A deferred request (taken once, requeued on pool exhaustion)
+        transfers with started=True: the new owner must not repeat the
+        RUNNING handshake (a Future runs only once)."""
+        src, dst = RequestQueue(max_depth=4), RequestQueue(max_depth=4)
+        fut = src.submit("deferred")
+        (req,) = src.take(1, 0.0)
+        assert req.started
+        src.requeue([req])  # same-queue deferral (the PR 10 form)
+        src.close()
+        moved = src.extract_pending()
+        assert moved == [req] and moved[0].started
+        dst.requeue(moved)
+        (back,) = dst.take(1, 0.0)
+        assert back is req
+        back.future.set_result("ok")
+        assert fut.result(timeout=0) == "ok"
+
+    def test_transferred_request_failure_counted_once_by_new_owner(self):
+        src, dst = RequestQueue(max_depth=4), RequestQueue(max_depth=4)
+        src.submit("doomed", timeout_s=0.001)
+        src.close()
+        reqs = src.extract_pending()
+        before = self._failed_total()
+        dst.requeue(reqs)
+        time.sleep(0.01)
+        assert dst.take(1, 0.0) == []  # expired in the NEW queue
+        assert self._failed_total() == before + 1
