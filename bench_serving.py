@@ -76,6 +76,16 @@ measure a no-op). Emits `sp_axis`, `prefill_shard_tokens`,
 stay bitwise across sp. Keep the prompt long: below ~1k tokens the
 per-chunk fixed costs beat the q-split and sp measures a LOSS
 (PERF.md).
+
+Elastic autoscaling section (ISSUE 15): `BENCH_AUTOSCALE=1` drives a
+1-replica MLP fleet through a stepped open-loop pattern (low -> 4x the
+calibrated single-replica capacity -> low) with an AutoScaler reading
+queue depth and actuating the drain-safe replica scale path. Emits
+`scale_events`, `replica_trajectory` (replica count at every controller
+tick), `slo_burn_before_after` (rolling burn at burst end vs after
+recovery, window `BENCH_AUTOSCALE_SLO_WINDOW`=3 s), and the full
+`autoscale` block (`BENCH_AUTOSCALE_REQUESTS`=192 burst requests,
+`BENCH_AUTOSCALE_MAX`=3 replicas).
 """
 
 import json
@@ -567,6 +577,121 @@ def _fabric_section():
     }
 
 
+def _autoscale_section():
+    """Elastic autoscaling under stepped open-loop load (ISSUE 15;
+    ``BENCH_AUTOSCALE=1`` enables): a 1-replica MLP fleet is driven
+    low -> 4x-capacity burst -> low while an :class:`AutoScaler` reads
+    the engine's queue depth and resizes the ReplicaPool through the
+    drain-safe actuators. Emits the scale-event count, the replica-count
+    trajectory (sampled at every controller tick), and the rolling SLO
+    burn at the end of the burst vs after recovery — the artifact shows
+    elasticity absorbing the step, not just that ticks happened."""
+    if os.environ.get("BENCH_AUTOSCALE", "0") != "1":
+        return None
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.autoscale import AutoScaler, AutoscalePolicy
+    from sparkdl_tpu.observability.slo import SLO
+    from sparkdl_tpu.serving import ServingEngine
+    from sparkdl_tpu.serving.replicas import ReplicaPool
+
+    rng = np.random.default_rng(11)
+    dim = int(os.environ.get("BENCH_AUTOSCALE_FEATURES", "256"))
+    max_replicas = int(os.environ.get("BENCH_AUTOSCALE_MAX", "3"))
+    n_burst = int(os.environ.get("BENCH_AUTOSCALE_REQUESTS", "192"))
+    window_s = float(os.environ.get("BENCH_AUTOSCALE_SLO_WINDOW", "3.0"))
+    ws = [jnp.asarray(rng.standard_normal((dim, dim)), jnp.float32) / dim
+          for _ in range(2)]
+
+    def apply_fn(batch):
+        h = batch["x"]
+        for w in ws:
+            h = jnp.tanh(h @ w)
+        return h
+
+    def max_burn(report):
+        burn = 0.0
+        for d in (report.get("latency"), report.get("availability")):
+            if isinstance(d, dict) and d.get("burn_rate") is not None:
+                burn = max(burn, float(d["burn_rate"]))
+        return round(burn, 4)
+
+    pool = ReplicaPool(apply_fn, batch_size=16, n_replicas=1)
+    warm = {"x": np.zeros((16, dim), np.float32)}
+    pool.warmup(warm)
+    slo = SLO(name="bench_autoscale", latency_threshold_s=0.05,
+              latency_target=0.95, availability_target=0.999,
+              window_s=window_s)
+    engine = ServingEngine(pool, max_queue_depth=max(4 * n_burst, 256),
+                           max_wait_s=0.002, slo=slo)
+    scaler = AutoScaler(
+        pool=pool,
+        signals=lambda: (float(engine.queue.depth), 0.0),
+        policy=AutoscalePolicy(
+            min_replicas=1, max_replicas=max_replicas, queue_high=4.0,
+            queue_low=0.5, hysteresis=1, cooldown_ticks=1,
+            tabu_ticks=3),
+        warmup_arrays=warm,
+    )
+    trajectory = []
+
+    def tick():
+        scaler.tick()
+        trajectory.append(len(pool.replicas))
+
+    # calibrate the single-replica round trip -> the step sizes
+    x1 = {"x": np.zeros((dim,), np.float32)}
+    engine.submit(x1).result(timeout=120)
+    t_cal = time.perf_counter()
+    k = 20
+    for _ in range(k):
+        engine.submit(x1).result(timeout=120)
+    per_request = (time.perf_counter() - t_cal) / k
+    base_rate = 1.0 / per_request
+
+    def replay(n, rate):
+        arr = np.cumsum(rng.exponential(1.0 / rate, n))
+        futs = []
+        t0 = time.perf_counter()
+        for i, t_arr in enumerate(arr):
+            lag = t0 + t_arr - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+            futs.append(engine.submit(
+                {"x": rng.standard_normal(dim).astype(np.float32)}))
+            if i % 4 == 3:
+                tick()
+        for f in futs:
+            f.result(timeout=120)
+
+    n_low = max(16, n_burst // 6)
+    replay(n_low, 0.5 * base_rate)        # steady low load
+    replay(n_burst, 4.0 * base_rate)      # step: 4x the 1-replica rate
+    burn_before = max_burn(engine.slo_tracker.sample())
+    peak_replicas = max(trajectory) if trajectory else 1
+    replay(n_low, 0.5 * base_rate)        # load drops
+    deadline = time.monotonic() + 10.0
+    while len(pool.replicas) > 1 and time.monotonic() < deadline:
+        tick()
+        time.sleep(0.01)
+    burn_after = max_burn(engine.slo_tracker.sample())
+    ctl = scaler.snapshot()["autoscaler"]
+    engine.close()
+    scaler.close()
+    pool.close()
+    return {
+        "requests": n_low + n_burst + n_low,
+        "burst_rate_per_s": round(4.0 * base_rate, 1),
+        "scale_events": scaler.decision_count,
+        "replica_trajectory": trajectory,
+        "replicas_peak": peak_replicas,
+        "replicas_final": trajectory[-1] if trajectory else 1,
+        "slo_burn_before_after": {
+            "before": burn_before, "after": burn_after},
+        "controller": ctl,
+    }
+
+
 def main() -> None:
     n_replicas = int(os.environ.get("BENCH_REPLICAS", "1"))
     n_sp = int(os.environ.get("BENCH_SP", "2"))
@@ -708,6 +833,10 @@ def main() -> None:
     # over BENCH_HOSTS in-process hosts, medians of 3.
     fabric = _fabric_section()
 
+    # Elastic autoscaling (ISSUE 15): stepped open-loop load over an
+    # AutoScaler-driven ReplicaPool (BENCH_AUTOSCALE=1 enables).
+    autoscale = _autoscale_section()
+
     gap = calibrate_dispatch_gap()
     n_dispatches = dispatch_count("serving")
     snap_wall = registry().snapshot().get(
@@ -773,6 +902,15 @@ def main() -> None:
         "fabric_p95_ms_rr": (fabric or {}).get(
             "round_robin", {}).get("p95_ms"),
         "fabric": fabric,
+        # Elastic autoscaling (ISSUE 15): scale-event count, replica
+        # trajectory, and SLO burn at burst end vs after recovery
+        # (None when BENCH_AUTOSCALE != 1)
+        "scale_events": (autoscale or {}).get("scale_events"),
+        "replica_trajectory": (autoscale or {}).get(
+            "replica_trajectory"),
+        "slo_burn_before_after": (autoscale or {}).get(
+            "slo_burn_before_after"),
+        "autoscale": autoscale,
         # SLO accounting + flight recorder (ISSUE 9): declared objective
         # with rolling burn, and the event-ring volume this run produced
         "slo": replica_snap.get("slo"),

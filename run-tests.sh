@@ -326,11 +326,107 @@ print(f"fabric smoke OK: affinity hit-rate {af_rate:.2f} > "
       "zero lost + quarantine + postmortem, drain fault recovered")
 EOF
 
+# Elastic-autoscale smoke (ISSUE 15): a 1-replica pool + engine under
+# manual controller ticks. (a) load step -> scale-up within a bounded
+# tick count; (b) load drop -> drain-based scale-down with ZERO lost
+# accepted requests (every Future resolves correctly); (c) an injected
+# replica.scale_down fault defers the scale event (healthz degraded,
+# nothing moves, nothing lost) and the retry lands clean; (d) every
+# decision is visible in the flight ring and /healthz recovers to ok.
+JAX_PLATFORMS=cpu \
+SPARKDL_TPU_FAULT_PLAN="seed=7;autoscale.decide:RuntimeError@4;replica.scale_down:OSError@1;kv_pool.resize:OSError@9" \
+python - <<'EOF'
+import threading
+import time
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.autoscale import AutoScaler, AutoscalePolicy
+from sparkdl_tpu.observability.flight import flight_recorder, healthz_report
+from sparkdl_tpu.serving import ReplicaPool, ServingEngine
+from sparkdl_tpu.serving.kv_blocks import KVBlockPool
+
+DIM = 8
+W = jnp.asarray(np.random.default_rng(0).standard_normal((DIM, DIM)),
+                jnp.float32) / DIM
+
+def apply_fn(b):
+    return jnp.tanh(b["x"] @ W)
+
+pool = ReplicaPool(apply_fn, batch_size=8, n_replicas=1)
+warm = {"x": np.zeros((8, DIM), np.float32)}
+pool.warmup(warm)
+engine = ServingEngine(pool, max_queue_depth=4096, max_wait_s=0.002)
+kv = KVBlockPool(64, 4)
+depth = [0.0]
+scaler = AutoScaler(pool=pool, kv_pool=kv, kv_lock=threading.Lock(),
+                    signals=lambda: (depth[0], 0.0),
+                    policy=AutoscalePolicy(max_replicas=2, hysteresis=2,
+                                           cooldown_ticks=1, tabu_ticks=2,
+                                           kv_step_blocks=8),
+                    warmup_arrays=warm)
+futs = [engine.submit({"x": np.full((DIM,), float(i % 5), np.float32)})
+        for i in range(64)]
+# (a) load step: scale-up within N ticks (hysteresis 2 -> 2 ticks)
+depth[0] = 40.0
+ticks_to_scale = 0
+for _ in range(6):
+    scaler.tick(); ticks_to_scale += 1
+    if len(pool.replicas) == 2:
+        break
+assert len(pool.replicas) == 2, "no scale-up under load step"
+assert ticks_to_scale <= 3, f"scale-up took {ticks_to_scale} ticks"
+# (b)+(c) load drop: the kv tier shrinks first (one step per cooldown
+# window), then the FIRST replica scale-down attempt hits the injected
+# replica.scale_down@1 fault -> the decision defers (nothing moves) and
+# the retry lands clean; autoscale.decide@4 also defers one whole pass
+# mid-sequence. Drive ticks until the pool is back to 1.
+depth[0] = 0.0
+saw_deferred = saw_degraded = False
+deadline = time.monotonic() + 30.0
+while len(pool.replicas) > 1 and time.monotonic() < deadline:
+    scaler.tick()
+    if scaler.state == "deferred":
+        saw_deferred = True
+        saw_degraded |= healthz_report()["status"] == "degraded"
+    time.sleep(0.005)
+assert len(pool.replicas) == 1, "no drain-based scale-down"
+assert saw_deferred, "injected fault never deferred a scale decision"
+assert saw_degraded, "deferred scale event did not degrade /healthz"
+# ZERO lost: every accepted request resolves with the right answer
+expect = {v: np.tanh(np.full((DIM,), float(v)) @ np.asarray(W))
+          for v in range(5)}
+for i, f in enumerate(futs):
+    np.testing.assert_allclose(np.asarray(f.result(timeout=60)),
+                               expect[i % 5], rtol=1e-5)
+snap = engine.snapshot()
+assert snap["completed"] == 64 and snap["failed"] == 0, snap
+# (d) decisions visible; healthz recovered
+kinds = [str(e.get("kind")) for e in flight_recorder().events()]
+assert "autoscale.decision" in kinds
+assert "autoscale.deferred" in kinds
+assert "pool.scale_up" in kinds and "pool.scale_down" in kinds
+for _ in range(4):
+    scaler.tick()
+assert scaler.state == "ok"
+assert healthz_report()["status"] == "ok", healthz_report()
+a = healthz_report()["autoscalers"]
+assert a and a[0]["state"] == "ok", a
+engine.close(); scaler.close(); pool.close()
+print("autoscale smoke OK: step -> scale-up in "
+      f"{ticks_to_scale} ticks, drop -> drain-based scale-down, "
+      "injected scale_down/decide faults deferred (healthz degraded "
+      "-> ok), 64/64 requests exact, decisions in flight ring")
+EOF
+
 # Online serving bench: same one-JSON-line contract; vs_baseline is the
 # micro-batch / batch-of-1 throughput ratio under open-loop Poisson load.
 # BENCH_SPEC_K/BENCH_KV_DTYPE are pinned: the contract below asserts the
 # spec/quant sections, so the ambient environment must not disable them.
+# BENCH_AUTOSCALE=1: the elastic-autoscaling section must emit scale
+# events and the replica trajectory for the contract below.
 JAX_PLATFORMS=cpu BENCH_REQUESTS=64 BENCH_SPEC_K=4 BENCH_KV_DTYPE=int8 \
+  BENCH_AUTOSCALE=1 \
   python bench_serving.py | tail -1 | python -c '
 import json, sys
 rec = json.loads(sys.stdin.readline())
@@ -404,8 +500,23 @@ assert sum(fb["routed"]["routed_per_host"].values()) >= \
 assert "sparkdl_fabric_routed_total" in obs, sorted(obs)
 assert "sparkdl_fabric_affinity_hits_total" in obs, sorted(obs)
 assert "sparkdl_fabric_digest_blocks" in obs, sorted(obs)
+# ISSUE 15: elastic autoscaling — the stepped load must produce scale
+# events with a visible replica trajectory (up during the burst, back
+# down after), SLO burn sampled before/after, and the autoscale metric
+# families live on the spine
+au = rec["autoscale"]
+assert rec["scale_events"] >= 2, rec["scale_events"]
+traj = rec["replica_trajectory"]
+assert max(traj) >= 2, traj          # the burst scaled the pool up
+assert au["replicas_final"] == 1, au  # and the drop scaled it back
+sba = rec["slo_burn_before_after"]
+assert sba["before"] is not None and sba["after"] is not None, sba
+assert au["controller"]["state"] == "ok", au["controller"]
+assert "sparkdl_autoscale_decisions_total" in obs, sorted(obs)
+assert "sparkdl_autoscale_replicas" in obs, sorted(obs)
+assert "sparkdl_autoscale_ticks_total" in obs, sorted(obs)
 print("bench_serving contract OK (snapshot + slo + flight + kv + spec "
-      "+ sp + fabric embedded)")
+      "+ sp + fabric + autoscale embedded)")
 '
 
 # Paged-KV smoke (ISSUE 10): (a) a shared-prefix workload through the

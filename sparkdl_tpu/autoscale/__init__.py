@@ -1,0 +1,26 @@
+"""Elastic fleet autoscaling: SLO-driven grow/shrink with drain-safe
+scale-down (ISSUE 15, ROADMAP item 4).
+
+:class:`AutoScaler` closes the loop from the observability spine (SLO
+burn, queue depth, KV deferral streaks) to the fleet's capacity knobs:
+replica count (:meth:`ReplicaPool.add_replica` /
+:meth:`ReplicaPool.remove_replica`), the KV block pool's serving/spare
+split (:meth:`KVBlockPool.grow` / :meth:`KVBlockPool.shrink`), and
+fabric host membership (:meth:`Router.remove_host` over the shared
+drain path). The control law is PR 8's AutoTuner discipline —
+hysteresis, post-move cooldown, and an SLO-burn veto that reverts a
+scale-down and tabus the direction. See
+:mod:`sparkdl_tpu.autoscale.controller`.
+"""
+
+from sparkdl_tpu.autoscale.controller import (
+    AutoScaler,
+    AutoscalePolicy,
+    read_autoscale_signals,
+)
+
+__all__ = [
+    "AutoScaler",
+    "AutoscalePolicy",
+    "read_autoscale_signals",
+]

@@ -66,6 +66,7 @@ from typing import Any, Iterable
 
 from sparkdl_tpu.observability import flight
 from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.reliability.breaker import ProbationBreaker
 from sparkdl_tpu.reliability.faults import fault_point
 from sparkdl_tpu.reliability.retry import record_retry
 from sparkdl_tpu.serving.queue import QueueFullError, Request
@@ -140,25 +141,45 @@ class _HostState:
     router lock)."""
 
     __slots__ = ("handle", "host_id", "outstanding", "routed",
-                 "consecutive_failures", "quarantined", "probing",
-                 "probation_until", "probation_backoff_s", "draining",
-                 "health_status", "digest", "weight", "saturation")
+                 "breaker", "draining", "health_status", "digest",
+                 "weight", "saturation")
 
-    def __init__(self, handle: HostHandle, saturation: "int | None"):
+    def __init__(self, handle: HostHandle, saturation: "int | None",
+                 breaker: ProbationBreaker):
         self.handle = handle
         self.host_id = handle.host_id
         self.outstanding = 0
         self.routed = 0
-        self.consecutive_failures = 0
-        self.quarantined = False
-        self.probing = False
-        self.probation_until = 0.0
-        self.probation_backoff_s = 0.0
+        #: the shared quarantine/probation state machine (mutated under
+        #: the router lock — one implementation with ReplicaPool)
+        self.breaker = breaker
         self.draining = False
         self.health_status = "ok"
         self.digest: "HostDigest | None" = None
         self.weight = 1
         self.saturation = saturation if saturation is not None else 256
+
+    # breaker state read-throughs (tests and snapshots read these; all
+    # WRITES go through the breaker's transition verbs)
+    @property
+    def quarantined(self) -> bool:
+        return self.breaker.quarantined
+
+    @property
+    def probing(self) -> bool:
+        return self.breaker.probing
+
+    @property
+    def consecutive_failures(self) -> int:
+        return self.breaker.consecutive_failures
+
+    @property
+    def probation_until(self) -> float:
+        return self.breaker.probation_until
+
+    @property
+    def probation_backoff_s(self) -> float:
+        return self.breaker.probation_backoff_s
 
 
 class Router:
@@ -205,12 +226,6 @@ class Router:
         if probation_s is not None and probation_s <= 0:
             raise ValueError(
                 f"probation_s must be > 0 or None, got {probation_s}")
-        states = [_HostState(h, max_outstanding) for h in hosts]
-        if not states:
-            raise ValueError("a Router needs at least one host")
-        ids = [s.host_id for s in states]
-        if len(set(ids)) != len(ids):
-            raise ValueError(f"duplicate host ids: {sorted(ids)}")
         self.policy = policy
         self.affinity_weight = affinity_weight
         self.load_weight = load_weight
@@ -223,6 +238,13 @@ class Router:
         self.max_outstanding = max_outstanding
         self.session_capacity = session_capacity
         self.refresh_interval_s = refresh_interval_s
+        states = [_HostState(h, max_outstanding, self._make_breaker())
+                  for h in hosts]
+        if not states:
+            raise ValueError("a Router needs at least one host")
+        ids = [s.host_id for s in states]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate host ids: {sorted(ids)}")
         self._hosts: "dict[str, _HostState]" = {
             s.host_id: s for s in states}
         self._sessions: "collections.OrderedDict[Any, str]" = \
@@ -248,6 +270,13 @@ class Router:
                 target=self._refresh_worker,
                 name="sparkdl-fabric-refresh", daemon=True)
             self._refresh_thread.start()
+
+    def _make_breaker(self) -> ProbationBreaker:
+        return ProbationBreaker(
+            max_failures=self.max_failures,
+            probation_s=self.probation_s,
+            probation_max_s=self.probation_max_s,
+        )
 
     # -- submission ----------------------------------------------------------
     def submit(self, payload: Any, *, timeout_s: "float | None" = None,
@@ -281,7 +310,7 @@ class Router:
                     # same release as the async path: a request-level
                     # reject at the door (bad prompt) says nothing
                     # about the host — free the probe slot
-                    state.probing = False
+                    state.breaker.release_probe()
             if reroute:
                 # the host refused at the door (raced saturation, drain,
                 # injected host.submit fault): same failover path as an
@@ -336,8 +365,7 @@ class Router:
                 if s is not exclude and not s.draining
                 and s.health_status not in ("unhealthy", "unreachable")
                 and (not s.quarantined
-                     or (not transfer
-                         and self._probe_due_locked(s, now)))
+                     or (not transfer and s.breaker.probe_due(now)))
             ]
             if candidates:
                 chosen = self._sticky_locked(rec, candidates)
@@ -346,7 +374,7 @@ class Router:
                         rec, candidates, hashes_by_bs,
                         include_saturated=transfer)
                 if chosen.quarantined:
-                    chosen.probing = True
+                    chosen.breaker.begin_probe()
                     probe = True
                 chosen.outstanding += 1
                 chosen.routed += 1
@@ -372,10 +400,6 @@ class Router:
         if affine:
             _M_AFFINITY.inc(host=chosen.host_id)
         return chosen
-
-    def _probe_due_locked(self, s: _HostState, now: float) -> bool:
-        return (self.probation_s is not None and not s.probing
-                and now >= s.probation_until)
 
     def _sticky_locked(self, rec: _Placement,
                        candidates: "list[_HostState]"
@@ -473,16 +497,9 @@ class Router:
         exc = (CancelledError("host cancelled the request")
                if fut.cancelled() else fut.exception())
         if exc is None:
-            rejoined = False
             with self._lock:
                 state.outstanding -= 1
-                state.consecutive_failures = 0
-                state.probing = False
-                if self.probation_s is not None:
-                    state.probation_backoff_s = self.probation_s
-                if state.quarantined:
-                    state.quarantined = False
-                    rejoined = True
+                rejoined = state.breaker.record_success()
             if rejoined:
                 flight.record_event(
                     "fabric.host_reintegrated", host=state.host_id)
@@ -498,7 +515,7 @@ class Router:
                 # prompt): inconclusive about the HOST — release the
                 # probe slot so the next due probe can run, else the
                 # host stays quarantined forever
-                state.probing = False
+                state.breaker.release_probe()
         if isinstance(exc, HOST_LEVEL_ERRORS):
             self._fail_or_reroute(rec, state, caller, exc)
         else:
@@ -544,22 +561,10 @@ class Router:
             now = time.monotonic()
             if state.probing and state.quarantined:
                 # failed probation probe: stay out, back off harder
-                state.probing = False
-                state.probation_backoff_s = min(
-                    state.probation_backoff_s * 2.0,
-                    self.probation_max_s)
-                state.probation_until = now + state.probation_backoff_s
+                state.breaker.record_probe_failure(now)
                 probe_failed = True
             else:
-                state.probing = False
-                state.consecutive_failures += 1
-                if (state.consecutive_failures >= self.max_failures
-                        and not state.quarantined):
-                    state.quarantined = True
-                    if self.probation_s is not None:
-                        state.probation_backoff_s = self.probation_s
-                        state.probation_until = now + self.probation_s
-                    quarantined_now = True
+                quarantined_now = state.breaker.record_failure(now)
         if probe_failed:
             flight.record_event(
                 "fabric.probe_failed", host=state.host_id,
@@ -581,30 +586,41 @@ class Router:
         outside the router lock). The auto-refresh thread calls this on
         its cadence; tests call it manually after seeding caches."""
         for state in list(self._hosts.values()):
-            try:
-                cap = state.handle.capacity()
-                digest = HostDigest.from_snapshot(
-                    state.handle.prefix_digest(self.digest_entries))
-                health = state.handle.health()
-            except Exception as e:
-                with self._lock:
-                    state.health_status = "unreachable"
-                flight.record_event(
-                    "fabric.refresh_failed", host=state.host_id,
-                    error=type(e).__name__)
-                continue
-            weight = (max(1, int(cap.get("replica_count") or 1))
-                      * max(1, int(cap.get("n_slots") or 1)))
-            saturation = self.max_outstanding
-            if saturation is None:
-                saturation = (int(cap.get("max_queue_depth") or 256)
-                              + int(cap.get("n_slots") or 0))
+            self._refresh_host(state)
+
+    def _refresh_host(self, state: _HostState) -> None:
+        try:
+            cap = state.handle.capacity()
+            digest = HostDigest.from_snapshot(
+                state.handle.prefix_digest(self.digest_entries))
+            health = state.handle.health()
+        except Exception as e:
             with self._lock:
-                state.weight = weight
-                state.saturation = saturation
-                state.digest = digest
-                state.health_status = str(
-                    health.get("status") or "ok")
+                state.health_status = "unreachable"
+            flight.record_event(
+                "fabric.refresh_failed", host=state.host_id,
+                error=type(e).__name__)
+            return
+        weight = (max(1, int(cap.get("replica_count") or 1))
+                  * max(1, int(cap.get("n_slots") or 1)))
+        saturation = self.max_outstanding
+        if saturation is None:
+            saturation = (int(cap.get("max_queue_depth") or 256)
+                          + int(cap.get("n_slots") or 0))
+        with self._lock:
+            if self._hosts.get(state.host_id) is not state:
+                # the host was removed (or replaced) while this poll
+                # was in flight: publishing now would resurrect a
+                # departed host's digest gauge/placement state
+                return
+            state.weight = weight
+            state.saturation = saturation
+            state.digest = digest
+            state.health_status = str(
+                health.get("status") or "ok")
+            # gauge published under the same lock as the membership
+            # check: remove_host's zeroing can never be overwritten by
+            # a poll that raced the removal
             _M_DIGEST_BLOCKS.set(
                 len(digest.hashes) if digest is not None else 0,
                 host=state.host_id)
@@ -633,9 +649,8 @@ class Router:
             raise KeyError(f"unknown fabric host {host_id!r}")
         with self._lock:
             state.draining = True
-            for k in [k for k, v in self._sessions.items()
-                      if v == host_id]:
-                del self._sessions[k]
+            self._purge_host_placement_state_locked(state)
+        _M_DIGEST_BLOCKS.set(0, host=host_id)
         flight.record_event("fabric.drain_begin", host=host_id)
         try:
             reqs = state.handle.drain()
@@ -755,6 +770,79 @@ class Router:
                 self._fail_transferred(req, exc)
 
         inner.add_done_callback(forward)
+
+    def _purge_host_placement_state_locked(self, state: _HostState
+                                           ) -> None:
+        """Forget everything that would steer NEW placements at a
+        departing host (ISSUE 15): its sticky sessions re-place on
+        survivors at their next turn instead of repeatedly failing over
+        to a drained/removed host, and its cached prefix digest stops
+        feeding affinity scores for a cache that is about to vanish."""
+        state.digest = None
+        for k in [k for k, v in self._sessions.items()
+                  if v == state.host_id]:
+            del self._sessions[k]
+
+    # -- elasticity (ISSUE 15: the autoscaler's fabric actuators) ------------
+    def add_host(self, handle: HostHandle) -> str:
+        """Join one host to the fabric at runtime (fleet scale-up, or
+        the revert of a not-yet-drained scale-down). The host starts
+        taking placements as soon as the post-add refresh seeds its
+        capacity/digest/health. Returns the host id."""
+        state = _HostState(handle, self.max_outstanding,
+                           self._make_breaker())
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("Router is closed")
+            if state.host_id in self._hosts:
+                raise ValueError(
+                    f"duplicate host id {state.host_id!r}")
+            self._hosts[state.host_id] = state
+        flight.record_event(
+            "fabric.host_added", host=state.host_id,
+            hosts=len(self._hosts))
+        # seed only the NEW host (the background thread keeps the rest
+        # fresh): joining must not cost O(fleet) handle round-trips
+        self._refresh_host(state)
+        return state.host_id
+
+    def remove_host(self, host_id: str, *, drain: bool = True
+                    ) -> HostHandle:
+        """Fleet scale-down: drain one host through the shared
+        :meth:`drain_host` path (unstarted requests transfer to
+        survivors — zero accepted requests lost) and forget it. The
+        HANDLE is returned, not closed — the caller owns the host's
+        lifecycle (the autoscaler parks it as spare capacity; an
+        un-drained handle can rejoin via :meth:`add_host`). Raises
+        ValueError when this is the last host."""
+        with self._lock:
+            if host_id not in self._hosts:
+                raise KeyError(f"unknown fabric host {host_id!r}")
+            if len(self._hosts) <= 1:
+                raise ValueError(
+                    "cannot remove the last fabric host; close() the "
+                    "router to stop the fabric")
+        if drain:
+            requeued = self.drain_host(host_id)
+        else:
+            requeued = 0
+        with self._lock:
+            if host_id not in self._hosts:  # raced another removal
+                raise KeyError(f"unknown fabric host {host_id!r}")
+            if len(self._hosts) <= 1:
+                # two concurrent removals of the last two hosts both
+                # passed the pre-drain check: the loser stays (drained
+                # but listed) rather than emptying the fleet
+                raise ValueError(
+                    "cannot remove the last fabric host; close() the "
+                    "router to stop the fabric")
+            state = self._hosts.pop(host_id)
+            self._purge_host_placement_state_locked(state)
+        _M_DIGEST_BLOCKS.set(0, host=host_id)
+        flight.record_event(
+            "fabric.host_removed", host=host_id, requeued=requeued,
+            hosts=len(self._hosts))
+        return state.handle
 
     def hosts(self) -> "list[str]":
         return list(self._hosts)

@@ -932,6 +932,7 @@ class FaultCoverageRule(Rule):
 PIN_MANAGED = {
     "SPARKDL_TPU_PREFETCH",
     "SPARKDL_TPU_PREFILL_CHUNK",
+    "SPARKDL_TPU_REPLICAS",
 }
 
 #: Documented direct-read allowlist (README "Static analysis"): process

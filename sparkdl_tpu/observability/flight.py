@@ -464,7 +464,10 @@ def healthz_report() -> dict:
     * **degraded** — a pool is serving with quarantined replicas, the
       process retry budget ran dry, a serving engine's KV block pool is
       on an exhaustion streak (admissions deferring — self-recovering
-      as slots retire, hence never ``unhealthy``), or the last restore
+      as slots retire, hence never ``unhealthy``), an elastic
+      autoscaler is mid-incident (a scale decision was vetoed by SLO
+      burn or deferred by a fault — state ``vetoed``/``deferred``,
+      self-clearing once the controller recovers), or the last restore
       fell back past a torn checkpoint / failed ambiguously
       (``fallback`` / ``unreadable`` / pinned-step ``corrupt``): route
       around if possible, still serving.
@@ -477,6 +480,7 @@ def healthz_report() -> dict:
     """
     pools = []
     kv_pools = []
+    autoscalers = []
     errors = []
     status = "ok"
     for name, fn in _providers_snapshot():
@@ -485,6 +489,16 @@ def healthz_report() -> dict:
         except Exception as e:
             errors.append({"provider": name, "error": repr(e)})
             continue
+        if isinstance(out, dict) and isinstance(
+                out.get("autoscaler"), dict):
+            a = out["autoscaler"]
+            autoscalers.append({"provider": name, **a})
+            if a.get("state") in ("vetoed", "deferred") \
+                    and status == "ok":
+                # a scale event is mid-incident (reverted by SLO burn,
+                # or deferred by a fault): degraded, never unhealthy —
+                # the controller retries/recovers on its own cadence
+                status = "degraded"
         if isinstance(out, dict) and isinstance(out.get("kv_pool"), dict):
             kvp = out["kv_pool"]
             kv_pools.append({
@@ -535,6 +549,7 @@ def healthz_report() -> dict:
         "status": status,
         "replica_pools": pools,
         "kv_pools": kv_pools,
+        "autoscalers": autoscalers,
         "provider_errors": errors,
         "retry_budget": {
             "remaining": budget.remaining,

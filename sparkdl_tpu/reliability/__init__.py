@@ -30,8 +30,13 @@ is a circuit breaker (probation probes with backoff, rejoin on
 success), a micro-batch whose replica dies is re-routed once before its
 riders see an error, and a hung dispatch is failed on a deadline
 instead of wedging the pool (:mod:`sparkdl_tpu.serving.replicas`).
+The circuit breaker itself is :mod:`~sparkdl_tpu.reliability.breaker`'s
+:class:`ProbationBreaker` — ONE quarantine/probation/probe/backoff
+state machine shared by ReplicaPool and the fabric Router (ISSUE 15),
+so a transition fix propagates to both consumers.
 """
 
+from sparkdl_tpu.reliability.breaker import ProbationBreaker
 from sparkdl_tpu.reliability.faults import (
     FaultPlan,
     FaultRule,
@@ -53,6 +58,7 @@ from sparkdl_tpu.reliability.supervisor import resumable_finetune
 __all__ = [
     "FaultPlan",
     "FaultRule",
+    "ProbationBreaker",
     "RetryBudget",
     "RetryExhaustedError",
     "RetryPolicy",

@@ -74,6 +74,9 @@ KNOWN_SITES = (
     "checkpoint.save",
     "kv.alloc",
     "kv.quantize",
+    "kv_pool.resize",
+    "autoscale.decide",
+    "replica.scale_down",
     "spec.verify",
     "sp.permute",
     "sp.gather",

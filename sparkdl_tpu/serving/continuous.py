@@ -2003,6 +2003,7 @@ class ContinuousGPTEngine:
             "blocks_total": self._pool.n_blocks,
             "blocks_used": self._pool.used_count,
             "blocks_used_peak": self._pool.used_peak,
+            "blocks_spare": self._pool.spare_count,
             "blocks_cached": self._prefix.cached_blocks,
             "prefix_hits": self._prefix.hit_tokens,
             "prefix_misses": self._prefix.miss_tokens,
@@ -2062,6 +2063,18 @@ class ContinuousGPTEngine:
         if spec is not None:
             out["spec"] = spec
         return out
+
+    def kv_autoscale_binding(self) -> "tuple[Any, Any]":
+        """``(pool, lock)`` for the elastic autoscaler's KV actuator
+        (ISSUE 15): the block pool whose serving/spare split the
+        controller resizes, plus the engine lock that guards every
+        pool mutation — ``AutoScaler(kv_pool=pool, kv_lock=lock)``
+        then grows/shrinks without racing admission."""
+        if self.kv_layout != "paged":
+            raise RuntimeError(
+                "KV autoscaling needs kv_layout='paged' (the dense "
+                "layout has no block pool to resize)")
+        return self._pool, self._lock
 
     def capacity(self) -> "dict[str, Any]":
         """The one structure a router's weighting reads (ISSUE 14):

@@ -18,11 +18,21 @@ THIS class owns the free list and refcounts — so
 
 Bookkeeping is plain Python under the engine lock — allocation is a
 host-side scheduling decision, never device work. The pool publishes
-``sparkdl_kv_blocks_total`` / ``sparkdl_kv_blocks_used`` gauges as
-delta contributions (several pools may live in one process; each adds
-its share instead of clobbering the others — the RequestQueue depth
-pattern) and carries the ``kv.alloc`` fault site so the chaos harness
-can simulate exhaustion deterministically.
+``sparkdl_kv_blocks_total`` / ``sparkdl_kv_blocks_used`` /
+``sparkdl_kv_blocks_spare`` gauges as delta contributions (several
+pools may live in one process; each adds its share instead of
+clobbering the others — the RequestQueue depth pattern) and carries the
+``kv.alloc`` fault site so the chaos harness can simulate exhaustion
+deterministically.
+
+Elastic capacity (ISSUE 15): :meth:`~KVBlockPool.shrink` parks free
+blocks as *spare* (non-allocatable) capacity and
+:meth:`~KVBlockPool.grow` returns them to service — the autoscaler's
+KV actuator, riding the ``kv_pool.resize`` fault site. Spare is pure
+host-side admission bookkeeping (the device pool array never moves);
+shrink refuses to cut the free list below the worst single-admission
+need ever recorded by :meth:`~KVBlockPool.record_deferral`, so parked
+capacity can never starve the largest request the pool has seen.
 
 Quantized layouts (ROADMAP item 3): the pool's DEVICE storage
 (:func:`~sparkdl_tpu.models.gpt.init_block_pool`) can hold blocks in
@@ -52,6 +62,10 @@ _M_USED = registry().gauge(
 _M_DEFERRED = registry().counter(
     "sparkdl_kv_admission_deferred_total",
     "admissions re-queued because the KV block pool was exhausted")
+_M_SPARE = registry().gauge(
+    "sparkdl_kv_blocks_spare",
+    "KV blocks parked as spare (non-allocatable) capacity by the "
+    "autoscaler, all pools")
 _M_DTYPE = registry().gauge(
     "sparkdl_kv_pool_dtype",
     "live KV block pools by storage layout", labels=("dtype",))
@@ -144,12 +158,23 @@ class KVBlockPool:
         #: bar a release must clear to end the episode (1 when the
         #: caller never said: any free block counts)
         self._deferred_need = 1
+        #: worst-case single-admission need EVER recorded — the floor
+        #: :meth:`shrink` must keep free (ISSUE 15: spare capacity can
+        #: never starve the largest request this pool has seen defer)
+        self.need_peak = 1
+        #: blocks parked as spare capacity by the autoscaler: off the
+        #: free list, never allocatable, not "used" either — grow()
+        #: returns them to service (the device pool array is untouched;
+        #: spare is host-side admission bookkeeping)
+        self._spare: "list[int]" = []
         self._closed = False
         self._g_total = GaugeShare(_M_TOTAL)
         self._g_used = GaugeShare(_M_USED)
+        self._g_spare = GaugeShare(_M_SPARE)
         self._g_dtype = GaugeShare(_M_DTYPE.labels(dtype=dtype))
         self._g_total.set(n_blocks)
         self._g_used.set(0)
+        self._g_spare.set(0)
         self._g_dtype.set(1)
 
     # -- introspection -------------------------------------------------------
@@ -163,9 +188,21 @@ class KVBlockPool:
         return len(self._free)
 
     @property
+    def spare_count(self) -> int:
+        """Blocks parked out of service by the autoscaler."""
+        return len(self._spare)
+
+    @property
+    def serving_count(self) -> int:
+        """Blocks in service (allocatable or allocated): physical
+        capacity minus spare."""
+        return self.n_blocks - len(self._spare)
+
+    @property
     def used_count(self) -> int:
-        """Blocks off the free list: live slots + cached prefixes."""
-        return self.n_blocks - self.free_count
+        """Blocks holding data: live slots + cached prefixes (spare
+        blocks are neither free nor used)."""
+        return self.n_blocks - self.free_count - len(self._spare)
 
     def refcount(self, block_id: int) -> int:
         return self._ref[block_id]
@@ -261,11 +298,68 @@ class KVBlockPool:
         self.deferral_streak += 1
         if need is not None:
             self._deferred_need = max(1, need)
+            self.need_peak = max(self.need_peak, self._deferred_need)
 
     def reset_deferral_streak(self) -> None:
         """An admission succeeded (or the queue drained past the
         pressure): the exhaustion episode is over."""
         self.deferral_streak = 0
+
+    # -- serving <-> spare resize (ISSUE 15: the autoscaler's actuator) ------
+    def grow(self, n: int) -> int:
+        """Return up to ``n`` spare blocks to the serving free list
+        (scale-up on deferral streaks). Returns the blocks actually
+        moved. The caller holds whatever lock guards allocation (the
+        engine lock) — same single-owner contract as every other
+        method here. ``kv_pool.resize`` is a fault site: an injected
+        fault aborts the move before any bookkeeping changes, so the
+        autoscaler defers the decision."""
+        from sparkdl_tpu.reliability.faults import fault_point
+
+        fault_point("kv_pool.resize")
+        if n < 0:
+            raise ValueError(f"cannot grow by {n} blocks")
+        moved = min(n, len(self._spare))
+        for _ in range(moved):
+            self._return_spare_block(self._spare.pop())
+        if moved and self.free_count >= self._deferred_need:
+            # capacity now covers the deferred need: the exhaustion
+            # episode ends exactly as a covering release() would end it
+            self.deferral_streak = 0
+        self._update_gauges()
+        return moved
+
+    def shrink(self, n: int) -> int:
+        """Park up to ``n`` FREE blocks as spare capacity (scale-down).
+        Guard: the free list is never shrunk below the worst
+        single-admission need this pool ever recorded
+        (:attr:`need_peak`, fed by :meth:`record_deferral`) — spare
+        capacity must not manufacture the exhaustion it exists to
+        absorb. Returns the blocks actually moved (possibly 0)."""
+        from sparkdl_tpu.reliability.faults import fault_point
+
+        fault_point("kv_pool.resize")
+        if n < 0:
+            raise ValueError(f"cannot shrink by {n} blocks")
+        allowance = self.free_count - max(self._deferred_need,
+                                          self.need_peak)
+        moved = max(0, min(n, allowance))
+        for _ in range(moved):
+            self._spare.append(self._take_free_block())
+        self._update_gauges()
+        return moved
+
+    def _take_free_block(self) -> int:
+        """Remove one block from the free structure for parking
+        (subclass hook, mirror of :meth:`_return_spare_block`). Only
+        called with ``free_count`` cover."""
+        return self._free.pop()
+
+    def _return_spare_block(self, bid: int) -> None:
+        """Put one parked block back on the free structure (subclass
+        hook). Unlike :meth:`_free_block` this must NOT touch used
+        accounting — a spare block was never used."""
+        self._free.append(bid)
 
     def _update_gauges(self) -> None:
         used = self.used_count
@@ -276,6 +370,7 @@ class KVBlockPool:
         # (test isolation) zeroes the gauges, and values only pushed at
         # construction would stay 0 while used recovers
         self._g_total.set(0 if self._closed else self.n_blocks)
+        self._g_spare.set(0 if self._closed else len(self._spare))
         self._g_dtype.set(0 if self._closed else 1)
 
     def close(self) -> None:
@@ -285,6 +380,7 @@ class KVBlockPool:
         self._closed = True
         self._g_total.set(0)
         self._g_used.set(0)
+        self._g_spare.set(0)
         self._g_dtype.set(0)
 
 
@@ -385,6 +481,17 @@ class SeqShardedBlockPool(KVBlockPool):
         shard = self.shard_of(bid)
         self._shard_free[shard].append(bid)
         self._shard_used[shard] -= 1
+
+    def _take_free_block(self) -> int:
+        # park from the shard with the MOST free blocks: spare capacity
+        # drains evenly off the stripes instead of exhausting one chip
+        # (spare blocks are neither free nor used — shard_used untouched)
+        shard = max(range(self.sp),
+                    key=lambda s: len(self._shard_free[s]))
+        return self._shard_free[shard].pop()
+
+    def _return_spare_block(self, bid: int) -> None:
+        self._shard_free[self.shard_of(bid)].append(bid)
 
     def _update_gauges(self) -> None:
         super()._update_gauges()

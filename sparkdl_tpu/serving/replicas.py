@@ -44,6 +44,14 @@ Contracts:
   late error re-enters the normal probation cycle.
 - **Drain**: ``close(drain=True)`` serves every accepted batch before
   stopping; ``drain=False`` fails queued batches immediately.
+- **Elasticity** (ISSUE 15): ``add_replica``/``remove_replica`` resize
+  the pool at runtime — the autoscaler's replica actuator. Scale-down
+  is drain-safe (unstarted work re-routes to survivors, the in-flight
+  batch finishes on the victim) and carries the ``replica.scale_down``
+  fault site so chaos plans can abort a scale event before it moves
+  state. The quarantine/probation machinery is the shared
+  :class:`~sparkdl_tpu.reliability.breaker.ProbationBreaker` (one
+  implementation with the fabric router).
 
 Drop-in: the pool exposes ``run_batch`` / ``run_batch_async`` /
 ``chunk_size``, so ``ServingEngine(ReplicaPool(...))`` works unchanged
@@ -66,6 +74,7 @@ from sparkdl_tpu.observability import flight
 from sparkdl_tpu.observability.metrics import StepMeter
 from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.observability.tracing import attach, current_context, span
+from sparkdl_tpu.reliability.breaker import ProbationBreaker
 from sparkdl_tpu.reliability.faults import fault_point
 from sparkdl_tpu.reliability.retry import record_retry
 from sparkdl_tpu.transformers._inference import BatchedRunner
@@ -221,17 +230,18 @@ class _Replica:
         #: queued + running batches (the routing signal), under pool lock
         self.outstanding = 0
         self.dispatched = 0
-        self.consecutive_failures = 0
-        self.quarantined = False
+        #: the shared quarantine/probation state machine (mutated under
+        #: the pool lock — reliability.breaker is the one implementation
+        #: this pool and the fabric router both run)
+        self.breaker = ProbationBreaker(
+            max_failures=pool.max_failures,
+            probation_s=pool.probation_s,
+            probation_max_s=pool.probation_max_s,
+        )
         #: quarantined because the watchdog caught a wedged dispatch:
         #: no probation probes until the wedged program resolves (probing
         #: would queue live work behind a stuck thread)
         self.hung = False
-        #: a probation probe is in flight (at most one at a time)
-        self.probing = False
-        #: monotonic time the next probation probe becomes due
-        self.probation_until = 0.0
-        self.probation_backoff_s = pool.probation_s or 0.0
         #: the in-flight work item, if any (watchdog scan target)
         self.current_work: "_Work | None" = None
         self.latency = StepMeter(n_chips=1, window=256, warmup_steps=0)
@@ -239,6 +249,28 @@ class _Replica:
             target=self._loop, name=f"sparkdl-replica-{index}", daemon=True
         )
         self.thread.start()
+
+    # breaker state read-throughs (tests and snapshots read these; all
+    # WRITES go through the breaker's transition verbs under pool lock)
+    @property
+    def quarantined(self) -> bool:
+        return self.breaker.quarantined
+
+    @property
+    def probing(self) -> bool:
+        return self.breaker.probing
+
+    @property
+    def consecutive_failures(self) -> int:
+        return self.breaker.consecutive_failures
+
+    @property
+    def probation_until(self) -> float:
+        return self.breaker.probation_until
+
+    @property
+    def probation_backoff_s(self) -> float:
+        return self.breaker.probation_backoff_s
 
     def _loop(self) -> None:
         m = _metrics()
@@ -383,6 +415,19 @@ class ReplicaPool:
         self._closed = False
         self._closing = threading.Event()
         self._rr = 0  # round-robin tiebreak cursor
+        #: elasticity (ISSUE 15): add_replica builds new executors from
+        #: the same factory/device ring construction used
+        self._make_runner = make_runner
+        self._devices = list(devices)
+        self._next_index = n_replicas
+        #: replicas removed by scale-down whose worker has not exited
+        #: yet: the watchdog keeps scanning them so an in-flight batch
+        #: that wedges AFTER removal still gets deadline-failed
+        self._retiring: "list[_Replica]" = []
+        #: replicas mid-warmup in add_replica (not yet routable): on the
+        #: watchdog scan so a wedged warmup dispatch is deadline-failed
+        #: — surfacing from add_replica — instead of blocking it forever
+        self._warming: "list[_Replica]" = []
         self.replicas = [
             _Replica(i, devices[i % len(devices)],
                      make_runner(devices[i % len(devices)]), self)
@@ -446,6 +491,12 @@ class ReplicaPool:
                 replica.outstanding += 1
                 work.owner = replica
                 depth.set(replica.outstanding, replica=str(replica.index))
+                # the enqueue happens UNDER the pool lock: remove_replica
+                # takes this lock to retire a victim, so a routing that
+                # picked the victim has finished its put before the
+                # drain/shutdown-sentinel sequence starts — work can
+                # never land behind the sentinel and strand its caller
+                replica.queue.put(work)
         except AllReplicasQuarantinedError:
             # outside the pool lock: the dump's context providers call
             # snapshot(), which takes it again
@@ -453,7 +504,6 @@ class ReplicaPool:
                 "pool.all_quarantined", replicas=len(self.replicas))
             flight.trigger_dump("all_replicas_quarantined")
             raise
-        replica.queue.put(work)
 
     def _pick_locked(self, work: _Work,
                      exclude: "_Replica | None") -> _Replica:
@@ -474,9 +524,9 @@ class ReplicaPool:
         if (self.probation_s is not None and self.max_reroutes >= 1
                 and work.retries == 0):
             for r in self.replicas:
-                if (r is not exclude and r.quarantined and not r.hung
-                        and not r.probing and now >= r.probation_until):
-                    r.probing = True
+                if (r is not exclude and not r.hung
+                        and r.breaker.probe_due(now)):
+                    r.breaker.begin_probe()
                     work.probe = True
                     return r
         healthy = [r for r in self.replicas
@@ -515,16 +565,11 @@ class ReplicaPool:
                        and not work.done.is_set())
             if claimed:
                 work.owner = None
-            replica.consecutive_failures = 0
-            replica.probing = False
-            if self.probation_s is not None:
-                replica.probation_backoff_s = self.probation_s
-            if replica.quarantined:
-                # circuit closes: probe success, or a watchdog-flagged
-                # dispatch that eventually completed
-                replica.quarantined = False
+            # circuit closes on success: probe success, or a watchdog-
+            # flagged dispatch that eventually completed
+            rejoined = replica.breaker.record_success()
+            if rejoined:
                 replica.hung = False
-                rejoined = True
         if rejoined:
             _metrics().reintegrated.inc()
             flight.record_event(
@@ -557,33 +602,19 @@ class ReplicaPool:
                 # hung-freeze so probation probes can reach the replica
                 # (only _on_success closes the circuit entirely)
                 replica.hung = False
-                if self.probation_s is not None:
-                    replica.probation_until = (
-                        now + replica.probation_backoff_s)
+                replica.breaker.schedule_probe(now)
             was_probe = work.probe and replica.quarantined
-            replica.probing = False
             probe_failed = False
             if was_probe:
                 # failed probe: stay quarantined, back off exponentially
-                replica.probation_backoff_s = min(
-                    replica.probation_backoff_s * 2.0,
-                    self.probation_max_s,
-                )
-                replica.probation_until = now + replica.probation_backoff_s
+                replica.breaker.record_probe_failure(now)
                 probe_failed = True
                 _log.warning(
                     "replica %d probation probe failed; next probe in "
                     "%.2fs", replica.index, replica.probation_backoff_s,
                 )
             else:
-                replica.consecutive_failures += 1
-                if (replica.consecutive_failures >= self.max_failures
-                        and not replica.quarantined):
-                    replica.quarantined = True
-                    if self.probation_s is not None:
-                        replica.probation_backoff_s = self.probation_s
-                        replica.probation_until = now + self.probation_s
-                    quarantined_now = True
+                quarantined_now = replica.breaker.record_failure(now)
         if probe_failed:
             flight.record_event(
                 "replica.probe_failed", replica=replica.index,
@@ -693,7 +724,16 @@ class ReplicaPool:
         interval = max(0.005, min(0.25, self.dispatch_timeout_s / 4.0))
         while not self._closing.wait(interval):
             now = time.monotonic()
-            for r in self.replicas:
+            with self._lock:
+                # retiring replicas stay scanned until their worker
+                # exits (drop the ones that finished cleanly); warming
+                # replicas are scanned so a wedged warmup dispatch
+                # deadline-fails instead of blocking add_replica
+                self._retiring = [r for r in self._retiring
+                                  if r.thread.is_alive()]
+                scan = (list(self.replicas) + list(self._retiring)
+                        + list(self._warming))
+            for r in scan:
                 work = r.current_work
                 if work is None or work.done.is_set():
                     continue
@@ -719,10 +759,8 @@ class ReplicaPool:
                     if work.owner is not r:
                         continue
                     work.owner = None
-                    already = r.quarantined
-                    r.quarantined = True
+                    already = not r.breaker.trip()
                     r.hung = True
-                    r.probing = False
                 _metrics().hung.inc()
                 if not already:
                     _metrics().quarantined.inc()
@@ -777,6 +815,122 @@ class ReplicaPool:
                              r.index, timeout_s)
         if self._watchdog is not None:
             self._watchdog.join(timeout_s)
+
+    # -- elasticity (ISSUE 15: the autoscaler's replica actuator) ------------
+    def add_replica(self, *,
+                    warmup_arrays: "dict[str, np.ndarray] | None" = None
+                    ) -> int:
+        """Grow the pool by one replica at runtime. The executor is
+        built (and, with ``warmup_arrays``, compiled) BEFORE the replica
+        joins routing, so live traffic never waits on a cold replica's
+        first compile. Devices round-robin off the construction ring
+        (the simulated-replica behavior on the CPU harness). Returns the
+        new replica's index — indices are never reused, so flight events
+        and per-replica metric labels stay unambiguous across scale
+        cycles."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ReplicaPool is closed")
+            index = self._next_index
+            self._next_index += 1
+        device = self._devices[index % len(self._devices)]
+        replica = _Replica(index, device, self._make_runner(device), self)
+        if warmup_arrays is not None:
+            work = _Work(warmup_arrays)
+            work.reroutable = False  # a failed warmup must SURFACE
+            work.owner = replica
+            with self._lock:
+                replica.outstanding += 1
+                self._warming.append(replica)
+                replica.queue.put(work)
+            try:
+                # unbounded wait is safe: the watchdog scans _warming,
+                # so with dispatch_timeout_s armed a wedged warmup is
+                # deadline-failed (reroutable=False -> the error
+                # surfaces here) exactly like a live replica's warmup
+                _PoolFuture(work).result()
+            except BaseException:
+                replica.queue.put(None)  # never joined routing: stop it
+                raise
+            finally:
+                with self._lock:
+                    if replica in self._warming:
+                        self._warming.remove(replica)
+        with self._lock:
+            if self._closed:
+                replica.queue.put(None)
+                raise RuntimeError("ReplicaPool is closed")
+            self.replicas.append(replica)
+            self._worker_ids[replica.thread.ident] = replica
+        flight.record_event(
+            "pool.scale_up", pool=self._flight_name, replica=index,
+            replicas=len(self.replicas),
+        )
+        _log.info("replica %d (%s) added; pool now %d replica(s)",
+                  index, device, len(self.replicas))
+        return index
+
+    def remove_replica(self, index: "int | None" = None, *,
+                       timeout_s: "float | None" = 30.0) -> int:
+        """Drain-safe scale-down: retire one replica with ZERO accepted
+        batches lost. The victim (``index``, or auto-picked: a
+        quarantined replica first, else the least-loaded) leaves routing
+        immediately, its queued-but-unstarted work re-routes to
+        survivors through the same requeue path a quarantine uses, and
+        its in-flight batch finishes on the victim before the worker
+        stops — the fleet-level drain contract (ISSUE 14) applied to
+        one host's chips. ``replica.scale_down`` is a fault site AT THE
+        TOP: an injected fault aborts the scale-down before any state
+        moves, so the autoscaler defers the decision instead of losing
+        work mid-drain. Raises ValueError below one replica."""
+        fault_point("replica.scale_down")
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ReplicaPool is closed")
+            if len(self.replicas) <= 1:
+                raise ValueError(
+                    "cannot scale below one replica; close() the pool "
+                    "to stop serving")
+            if index is not None:
+                victim = next(
+                    (r for r in self.replicas if r.index == index), None)
+                if victim is None:
+                    raise KeyError(f"no replica with index {index}")
+            else:
+                quarantined = [r for r in self.replicas if r.quarantined]
+                victim = min(quarantined or self.replicas,
+                             key=lambda r: r.outstanding)
+            self.replicas.remove(victim)
+            self._worker_ids.pop(victim.thread.ident, None)
+            # the watchdog keeps scanning the victim until its worker
+            # exits: an in-flight dispatch that wedges mid-retirement
+            # is still deadline-failed instead of hanging its riders
+            self._retiring.append(victim)
+        # unstarted work transfers to survivors (the victim no longer
+        # routes, so _route picks only live replicas); the in-flight
+        # batch — if any — resolves on the victim's worker below
+        self._requeue_queued(victim)
+        victim.queue.put(None)  # stop the worker after its last batch
+        victim.thread.join(timeout_s)
+        if victim.thread.is_alive():  # pragma: no cover - wedged program
+            _log.warning(
+                "replica %d worker did not stop in %ss (wedged "
+                "dispatch); its thread is daemon, off routing, and "
+                "stays under watchdog scan until it exits",
+                victim.index, timeout_s)
+        else:
+            with self._lock:
+                if victim in self._retiring:
+                    self._retiring.remove(victim)
+        _metrics().depth.set(0, replica=str(victim.index))
+        flight.record_event(
+            "pool.scale_down", pool=self._flight_name,
+            replica=victim.index, replicas=len(self.replicas),
+        )
+        _log.info("replica %d (%s) drained and removed; pool now %d "
+                  "replica(s)", victim.index, victim.device,
+                  len(self.replicas))
+        return victim.index
 
     def warmup(self, arrays: dict[str, np.ndarray]) -> None:
         """Dispatch ``arrays`` to EVERY replica (compile its buckets)

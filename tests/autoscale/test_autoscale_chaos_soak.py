@@ -1,0 +1,159 @@
+"""Chaos scale soak (slow, ISSUE 15 acceptance): open-loop load +
+repeated autoscaler-driven scale-up/scale-down + a seeded fault plan
+hitting the scale machinery itself (`replica.scale_down`,
+`autoscale.decide`, `kv_pool.resize`) and the dispatch path.
+
+Asserts the elasticity contract end to end: every accepted request
+RESOLVES (a result or a typed error — zero lost), the engine's counters
+reconcile exactly with the client's counts, every scale decision is
+visible in the flight recorder, and /healthz reports the autoscaler
+state (degraded during a deferred/vetoed scale event, ok after
+recovery)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sparkdl_tpu.autoscale import AutoScaler, AutoscalePolicy
+from sparkdl_tpu.observability import flight
+from sparkdl_tpu.observability.flight import healthz_report
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.reliability import faults
+from sparkdl_tpu.reliability.faults import inject
+from sparkdl_tpu.serving import ReplicaPool, ServingEngine
+from sparkdl_tpu.serving.kv_blocks import KVBlockPool
+from sparkdl_tpu.transformers._inference import BatchedRunner
+
+DIM = 6
+_W = jnp.asarray(
+    np.random.default_rng(17).standard_normal((DIM, DIM)), jnp.float32
+)
+
+
+def _apply(b):
+    return jnp.tanh(b["x"] @ _W)
+
+
+@pytest.mark.slow
+def test_chaos_scale_soak_zero_lost_and_observable():
+    registry().reset()
+    faults.disarm()
+
+    # oracle outputs BEFORE faults are armed (chaos-soak idiom)
+    oracle = BatchedRunner(_apply, batch_size=8, data_parallel=False)
+    expected = {
+        v: np.asarray(oracle.run_batch(
+            {"x": np.full((1, DIM), float(v), np.float32)})[0])
+        for v in range(23)
+    }
+
+    pool = ReplicaPool(_apply, batch_size=8, n_replicas=1,
+                       max_failures=3, probation_s=0.1,
+                       probation_max_s=2.0)
+    pool.warmup({"x": np.zeros((8, DIM), np.float32)})
+    engine = ServingEngine(pool, max_queue_depth=8192, max_wait_s=0.002)
+    kv = KVBlockPool(64, 4)
+
+    states_seen = set()
+    deferred_healthz = []
+
+    def signals():
+        # queue pressure from the engine itself; burn scripted by phase
+        return float(engine.queue.depth), burn_now[0]
+
+    burn_now = [0.0]
+    scaler = AutoScaler(
+        pool=pool, kv_pool=kv, kv_lock=threading.Lock(),
+        signals=signals,
+        policy=AutoscalePolicy(
+            min_replicas=1, max_replicas=3, queue_high=4.0,
+            queue_low=0.5, hysteresis=1, cooldown_ticks=1,
+            veto_window_ticks=3, veto_burn=2.0, tabu_ticks=3,
+            kv_step_blocks=8,
+        ),
+        warmup_arrays={"x": np.zeros((8, DIM), np.float32)},
+    )
+
+    n_requests = 360
+    futs = []
+    # the seeded plan rides the WHOLE soak: transient dispatch faults
+    # (absorbed by re-route/per-row retries), one scale-down aborted
+    # mid-decision, one whole decision pass deferred, one kv resize
+    # refused — the scale machinery must defer, never lose work
+    plan = ("seed=29;dispatch%0.01;replica.scale_down:OSError@2;"
+            "autoscale.decide:RuntimeError@5;kv_pool.resize:OSError@3")
+    with inject(plan):
+        try:
+            for i in range(n_requests):
+                futs.append(engine.submit(
+                    {"x": np.full((DIM,), float(i % 23), np.float32)}
+                ))
+                if i % 6 == 5:
+                    scaler.tick()
+                    states_seen.add(scaler.state)
+                    if scaler.state == "deferred":
+                        deferred_healthz.append(
+                            healthz_report()["status"])
+                if i % 60 == 59:
+                    # load valleys: enough quiet ticks that the
+                    # controller sees BOTH directions (the kv tier
+                    # shrinks first; the replica tier follows)
+                    for _ in range(30):
+                        scaler.tick()
+                        states_seen.add(scaler.state)
+                        if engine.queue.depth:
+                            time.sleep(0.005)
+                if i == 200:
+                    burn_now[0] = 5.0  # burn spike: veto window watch
+                if i == 220:
+                    burn_now[0] = 0.0
+            # every accepted request must RESOLVE: result or typed error
+            n_ok = n_err = 0
+            for i, f in enumerate(futs):
+                try:
+                    out = f.result(timeout=60)
+                except Exception:
+                    n_err += 1
+                else:
+                    np.testing.assert_allclose(
+                        out, expected[i % 23], rtol=1e-5)
+                    n_ok += 1
+            assert n_ok + n_err == n_requests
+            # settle: keep ticking until the controller reads ok
+            deadline = time.monotonic() + 10.0
+            while scaler.state != "ok" \
+                    and time.monotonic() < deadline:
+                scaler.tick()
+                time.sleep(0.01)
+            snap = engine.snapshot()
+        finally:
+            engine.close(drain=True)
+            scaler.close()
+            pool.close()
+
+    # counters reconcile exactly with the client's counts
+    assert snap["completed"] == n_ok, (snap["completed"], n_ok)
+    assert snap["failed"] == n_err, (snap["failed"], n_err)
+
+    # the soak actually scaled: up AND down decisions in the flight ring
+    kinds = [str(e.get("kind")) for e in flight.flight_recorder().events()]
+    assert "pool.scale_up" in kinds, "no scale-up happened"
+    assert "pool.scale_down" in kinds, "no drain-based scale-down"
+    assert "autoscale.decision" in kinds
+    # the injected decision fault deferred (visible + degraded healthz)
+    assert "autoscale.deferred" in kinds
+    assert "deferred" in states_seen
+    assert deferred_healthz and all(
+        s == "degraded" for s in deferred_healthz)
+    # recovered at the end
+    assert healthz_report()["status"] == "ok"
+    # the dispatch chaos really fired
+    inj = registry().get("sparkdl_faults_injected_total")
+    assert inj is not None and sum(inj.snapshot_values().values()) > 0
+    # autoscale spine series live
+    dec = registry().get("sparkdl_autoscale_decisions_total")
+    assert dec is not None and sum(dec.snapshot_values().values()) >= 2

@@ -1,0 +1,206 @@
+"""ProbationBreaker: the shared quarantine/probation state machine
+(ISSUE 15 satellite — the ROADMAP 1 named follow-on).
+
+The transition semantics were pinned by the ReplicaPool and Router
+suites before extraction (tests/serving/test_replica_probation.py,
+tests/fabric/test_fabric_router.py — both still run against the shared
+class); this file covers the machine itself plus parity: both consumers
+hold the SAME implementation, and their snapshot surfaces read through
+its state.
+"""
+
+import pytest
+
+from sparkdl_tpu.reliability.breaker import ProbationBreaker
+
+
+def _breaker(**kw):
+    kw.setdefault("max_failures", 3)
+    kw.setdefault("probation_s", 1.0)
+    kw.setdefault("probation_max_s", 8.0)
+    return ProbationBreaker(**kw)
+
+
+def test_opens_only_at_max_consecutive_failures():
+    b = _breaker(max_failures=3)
+    assert b.record_failure(now=10.0) is False
+    assert b.record_failure(now=10.0) is False
+    assert not b.quarantined
+    assert b.record_failure(now=10.0) is True
+    assert b.quarantined
+    assert b.consecutive_failures == 3
+    # the first probe is scheduled one probation_s out
+    assert b.probation_until == pytest.approx(11.0)
+    # further non-probe failures while open do not re-open
+    assert b.record_failure(now=10.5) is False
+
+
+def test_success_resets_streak_and_closes_circuit():
+    b = _breaker(max_failures=2)
+    b.record_failure(now=0.0)
+    assert b.record_success() is False  # nothing was open
+    assert b.consecutive_failures == 0
+    b.record_failure(now=0.0)
+    b.record_failure(now=0.0)
+    assert b.quarantined
+    b.record_probe_failure(now=1.0)  # backoff doubled to 2.0
+    assert b.probation_backoff_s == pytest.approx(2.0)
+    assert b.record_success() is True  # probe success closes
+    assert not b.quarantined
+    # backoff reset for the next episode
+    assert b.probation_backoff_s == pytest.approx(1.0)
+
+
+def test_probe_scheduling_and_backoff_cap():
+    b = _breaker(max_failures=1, probation_s=1.0, probation_max_s=3.0)
+    b.record_failure(now=0.0)
+    assert not b.probe_due(now=0.5)
+    assert b.probe_due(now=1.0)
+    b.begin_probe()
+    assert not b.probe_due(now=1.0)  # at most one probe in flight
+    b.record_probe_failure(now=1.0)  # 1 -> 2
+    assert b.probation_until == pytest.approx(3.0)
+    b.record_probe_failure(now=3.0)  # 2 -> 3 (capped)
+    assert b.probation_backoff_s == pytest.approx(3.0)
+    b.record_probe_failure(now=6.0)  # stays at the cap
+    assert b.probation_backoff_s == pytest.approx(3.0)
+
+
+def test_release_probe_frees_the_slot_without_backoff():
+    """An inconclusive probe outcome (the request's own failure) must
+    free the slot so the next due probe can run — and must NOT double
+    the backoff."""
+    b = _breaker(max_failures=1)
+    b.record_failure(now=0.0)
+    b.begin_probe()
+    b.release_probe()
+    assert b.probe_due(now=1.0)
+    assert b.probation_backoff_s == pytest.approx(1.0)
+
+
+def test_probation_none_disables_probes():
+    b = _breaker(max_failures=1, probation_s=None)
+    assert b.record_failure(now=0.0) is True
+    assert b.quarantined
+    assert not b.probe_due(now=1e9)  # permanent quarantine
+    b.schedule_probe(now=0.0)  # no-op
+    assert b.next_probe_in_s(now=0.0) is None
+    # success still closes (a late completion heals directly)
+    assert b.record_success() is True
+
+
+def test_trip_opens_without_streak_and_counts_once():
+    b = _breaker()
+    assert b.trip() is True  # was closed: consumer counts ONE quarantine
+    assert b.quarantined
+    assert b.trip() is False  # already open: no double-count
+    assert b.consecutive_failures == 0  # the streak was never touched
+    b.schedule_probe(now=5.0)
+    assert b.probation_until == pytest.approx(5.0 + b.probation_backoff_s)
+
+
+def test_next_probe_in_s_snapshot_surface():
+    b = _breaker(max_failures=1, probation_s=2.0)
+    assert b.next_probe_in_s(now=0.0) is None  # closed
+    b.record_failure(now=10.0)
+    assert b.next_probe_in_s(now=10.5) == pytest.approx(1.5)
+    assert b.next_probe_in_s(now=13.0) == 0.0  # overdue clamps at 0
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="max_failures"):
+        ProbationBreaker(max_failures=0)
+    with pytest.raises(ValueError, match="probation_s"):
+        ProbationBreaker(probation_s=0.0)
+    with pytest.raises(ValueError, match="probation_max_s"):
+        ProbationBreaker(probation_max_s=0.0)
+
+
+# -- consumer parity ----------------------------------------------------------
+
+def test_replica_pool_and_router_share_the_breaker():
+    """Both consumers hold ProbationBreaker instances built from their
+    own knobs, and their public/quarantine surfaces read through it —
+    the extraction left one implementation, not three."""
+    import numpy as np
+
+    from sparkdl_tpu.serving.replicas import ReplicaPool
+
+    def apply_fn(b):
+        return b["x"]
+
+    pool = ReplicaPool(apply_fn, batch_size=4, n_replicas=1,
+                       max_failures=2, probation_s=0.5,
+                       probation_max_s=4.0)
+    try:
+        r = pool.replicas[0]
+        assert isinstance(r.breaker, ProbationBreaker)
+        assert r.breaker.max_failures == 2
+        assert r.breaker.probation_s == 0.5
+        # the read-through properties ARE the breaker's state
+        r.breaker.record_failure(now=0.0)
+        assert r.consecutive_failures == 1
+        r.breaker.record_failure(now=0.0)
+        assert r.quarantined
+        assert pool.snapshot()["healthy_count"] == 0
+        r.breaker.record_success()
+        assert not r.quarantined
+        del np
+    finally:
+        pool.close()
+
+
+def test_router_host_state_reads_through_breaker():
+    from sparkdl_tpu.fabric.router import _HostState
+    from sparkdl_tpu.fabric.host import HostHandle
+
+    class _H(HostHandle):
+        host_id = "h0"
+
+    s = _HostState(_H(), None, ProbationBreaker(
+        max_failures=2, probation_s=0.5, probation_max_s=4.0))
+    assert isinstance(s.breaker, ProbationBreaker)
+    s.breaker.record_failure(now=0.0)
+    s.breaker.record_failure(now=0.0)
+    assert s.quarantined and s.consecutive_failures == 2
+    s.breaker.begin_probe()
+    assert s.probing
+    s.breaker.record_probe_failure(now=1.0)
+    assert s.probation_backoff_s == pytest.approx(1.0)
+    assert s.breaker.record_success() is True
+    assert not s.quarantined
+
+
+def test_identical_event_script_identical_transitions():
+    """Parity of the extracted machine: the pool-shaped and
+    router-shaped configurations driven through one event script
+    produce identical state trajectories (one rule set — a fix in
+    either consumer propagates to both)."""
+    script = [
+        ("fail", 0.0), ("fail", 0.1), ("fail", 0.2),  # opens at 3
+        ("probe_fail", 1.3),                          # backoff 2x
+        ("probe_fail", 3.5),                          # backoff 4x
+        ("success", None),                            # closes, resets
+        ("fail", 4.0),
+    ]
+    trajectories = []
+    for _consumer in ("replica_pool", "router"):
+        b = ProbationBreaker(max_failures=3, probation_s=1.0,
+                             probation_max_s=30.0)
+        states = []
+        for verb, now in script:
+            if verb == "fail":
+                b.record_failure(now=now)
+            elif verb == "probe_fail":
+                b.record_probe_failure(now=now)
+            else:
+                b.record_success()
+            states.append((b.quarantined, b.consecutive_failures,
+                           b.probation_backoff_s, b.probation_until))
+        trajectories.append(states)
+    assert trajectories[0] == trajectories[1]
+    # and the trajectory is the documented one
+    assert trajectories[0][2][0] is True          # opened on 3rd failure
+    assert trajectories[0][3][2] == pytest.approx(2.0)   # doubled
+    assert trajectories[0][4][2] == pytest.approx(4.0)   # doubled again
+    assert trajectories[0][5] == (False, 0, 1.0, trajectories[0][4][3])

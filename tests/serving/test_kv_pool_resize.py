@@ -1,0 +1,134 @@
+"""KV block pool serving<->spare resize (ISSUE 15): the autoscaler's
+KV actuator. Shrink parks FREE blocks as non-allocatable spare — never
+below the worst single-admission need the pool has recorded — and grow
+returns them to service, ending a live exhaustion episode exactly like
+a covering release() would."""
+
+import pytest
+
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.reliability.faults import inject
+from sparkdl_tpu.serving.kv_blocks import KVBlockPool, SeqShardedBlockPool
+
+
+def test_shrink_parks_free_blocks_and_bounds_allocation():
+    p = KVBlockPool(16, 4)
+    assert p.shrink(6) == 6
+    assert p.spare_count == 6
+    assert p.serving_count == 10
+    assert p.free_count == 10
+    assert p.used_count == 0
+    # allocation is bounded by SERVING capacity, not physical
+    assert p.allocate(11) is None
+    got = p.allocate(10)
+    assert got is not None and len(got) == 10
+    # spare blocks were never handed out
+    assert not (set(got) & set(p._spare))
+
+
+def test_shrink_refuses_below_worst_recorded_need():
+    p = KVBlockPool(16, 4)
+    p.record_deferral(need=6)
+    p.reset_deferral_streak()
+    # free 16, worst need 6 -> at most 10 may park
+    assert p.shrink(64) == 10
+    assert p.free_count == 6
+    # nothing more to take without violating the floor
+    assert p.shrink(1) == 0
+    # the floor is the PEAK need, not the latest: a smaller later need
+    # does not let spare eat the headroom the big request proved it uses
+    p.record_deferral(need=2)
+    p.reset_deferral_streak()
+    assert p.need_peak == 6
+    assert p.shrink(1) == 0
+
+
+def test_grow_returns_spare_and_ends_exhaustion_episode():
+    p = KVBlockPool(8, 4)
+    assert p.shrink(6) == 6
+    held = p.allocate(2)
+    assert held is not None
+    # serving capacity exhausted: the engine defers and records it
+    assert p.allocate(1) is None
+    p.record_deferral(need=1)
+    assert p.deferral_streak == 1
+    # grow covers the deferred need -> the episode ends at the grow,
+    # exactly like a covering release()
+    assert p.grow(4) == 4
+    assert p.deferral_streak == 0
+    assert p.spare_count == 2
+    got = p.allocate(4)
+    assert got is not None and len(got) == 4
+    # over-grow is clamped to what is parked
+    assert p.grow(100) == 2
+    assert p.spare_count == 0
+
+
+def test_resize_is_a_fault_site():
+    p = KVBlockPool(8, 4)
+    with inject("kv_pool.resize:OSError@1"):
+        with pytest.raises(OSError):
+            p.shrink(2)
+    # the injected fault aborted BEFORE any bookkeeping moved
+    assert p.spare_count == 0
+    assert p.free_count == 8
+    with inject("kv_pool.resize:OSError@2"):
+        assert p.shrink(2) == 2  # hit 1 passes
+        with pytest.raises(OSError):
+            p.grow(2)  # hit 2 injected
+    assert p.spare_count == 2
+
+
+def test_spare_gauge_and_close_retraction():
+    registry().reset()
+    p = KVBlockPool(8, 4)
+    p.shrink(3)
+    fam = registry().get("sparkdl_kv_blocks_spare")
+    assert fam is not None
+    assert fam.snapshot_values().get("", 0.0) == 3.0
+    used = registry().get("sparkdl_kv_blocks_used")
+    assert used.snapshot_values().get("", 0.0) == 0.0  # spare != used
+    p.close()
+    assert fam.snapshot_values().get("", 0.0) == 0.0
+
+
+def test_sharded_pool_parks_evenly_and_restores_stripes():
+    p = SeqShardedBlockPool(16, 4, sp=2)
+    assert p.shrink(4) == 4
+    # spare drains evenly off the stripes (max-free shard each time)
+    free_per_shard = [len(d) for d in p._shard_free]
+    assert free_per_shard == [6, 6]
+    # striped allocation still round-robins across shards
+    got = p.allocate(4)
+    assert {p.shard_of(b) for b in got} == {0, 1}
+    # used accounting ignores spare
+    assert p.used_count == 4
+    assert sum(p.shard_used_counts()) == 4
+    # grow returns each block to ITS shard
+    assert p.grow(4) == 4
+    assert len(p._shard_free[0]) + len(p._shard_free[1]) == 12
+    for shard, dq in enumerate(p._shard_free):
+        assert all(p.shard_of(b) == shard for b in dq)
+    # full cycle: release everything, park everything parkable, restore
+    p.release(p.deref(got))
+    assert p.used_count == 0
+    assert p.shrink(100) == 15  # need floor (1) keeps one free
+    assert p.grow(100) == 15
+    assert p.free_count == 16
+
+
+def test_release_streak_reset_respects_spare():
+    """The exhaustion-episode reset bar compares against SERVING free
+    blocks only — parked spare must not count as recovery capacity."""
+    p = KVBlockPool(8, 4)
+    assert p.shrink(2) == 2
+    got = p.allocate(6)
+    assert p.free_count == 0
+    p.record_deferral(need=4)
+    assert p.deferral_streak == 1
+    # freeing 2 < need 4: the episode continues
+    p.release(p.deref(got[:2]))
+    assert p.deferral_streak == 1
+    # freeing 2 more covers the need: episode over
+    p.release(p.deref(got[2:4]))
+    assert p.deferral_streak == 0
