@@ -692,6 +692,155 @@ def _autoscale_section():
     }
 
 
+def _disagg_section():
+    """Disaggregated prefill/decode serving (ISSUE 16;
+    ``BENCH_DISAGG=1`` enables): a ``BENCH_DISAGG_LONG_LEN``-token
+    prompt (default 3072) streams in while short interactive requests
+    are served — colocated (one engine shares every tick between the
+    long prompt's chunked prefill and live decode) vs disaggregated
+    (a PrefillWorker absorbs the long prompt, a DecodeWorker keeps the
+    interactive stream; one quantized KV-block handoff per request
+    crosses the tiers). Emits interactive p50/p95 per arm and their
+    ratio, the measured handoff-crossing latency p50 (wire codec +
+    transfer + install, max_new=1 so the Future resolves AT install),
+    and fp32-vs-int8 wire bytes — the int8 pool's storage IS the wire
+    format, so the crossing inherits its ~4x compression."""
+    if os.environ.get("BENCH_DISAGG", "0") != "1":
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.disagg import DecodeWorker, KVHandoff, PrefillWorker
+    from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+    from sparkdl_tpu.serving import ContinuousGPTEngine
+
+    long_len = int(os.environ.get("BENCH_DISAGG_LONG_LEN", "3072"))
+    n_int = int(os.environ.get("BENCH_DISAGG_REQUESTS", "12"))
+    dtype = os.environ.get("BENCH_DISAGG_KV_DTYPE", "int8")
+    int_len, int_new = 16, 16
+    max_len = long_len + 32
+    cfg = GPTConfig(
+        vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=128, max_seq_len=max_len,
+    )
+    model = GPTLMHeadModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(16)
+    long_prompt = rng.integers(1, cfg.vocab_size, long_len).tolist()
+    int_prompts = [rng.integers(1, cfg.vocab_size, int_len).tolist()
+                   for _ in range(n_int)]
+    chunk_warm = rng.integers(1, cfg.vocab_size, 256).tolist()
+    kw = dict(max_len=max_len, kv_layout="paged", kv_block_size=16,
+              prefill_chunk=256, kv_dtype=dtype, idle_wait_s=0.0005)
+
+    def pctl(xs, q):
+        return round(float(np.percentile(np.asarray(xs), q)) * 1e3, 2)
+
+    # -- colocated arm: interactive decode shares every tick with the
+    # long prompt's chunked prefill
+    eng = ContinuousGPTEngine(cfg, variables, n_slots=4, **kw)
+    eng.submit(int_prompts[0], int_new).result(timeout=600)  # warm
+    eng.submit(chunk_warm, 2).result(timeout=600)  # chunk program
+    long_fut = eng.submit(long_prompt, 4)
+    lat_col = []
+    for p in int_prompts:
+        t0 = time.perf_counter()
+        eng.submit(p, int_new).result(timeout=600)
+        lat_col.append(time.perf_counter() - t0)
+    long_fut.result(timeout=600)
+    eng.close()
+
+    # -- disaggregated arm: the long prompt stays on the prefill tier;
+    # the decode tier's ticks never see a prefill chunk
+    pre = PrefillWorker(cfg, variables, n_slots=2, **kw)
+    dec = DecodeWorker(cfg, variables, n_slots=4, **kw)
+    h0 = pre.submit(int_prompts[0], int_new).result(timeout=600)
+    out_dis = np.asarray(dec.submit_handoff(h0).result(timeout=600))
+    dec.submit_handoff(
+        pre.submit(chunk_warm, 2).result(timeout=600)).result(timeout=600)
+    long_hfut = pre.submit(long_prompt, 4)
+    long_decode = []
+    long_hfut.add_done_callback(
+        lambda f: long_decode.append(dec.submit_handoff(f.result())))
+    lat_dis = []
+    for p in int_prompts:
+        t0 = time.perf_counter()
+        h = pre.submit(p, int_new).result(timeout=600)
+        dec.submit_handoff(h).result(timeout=600)
+        lat_dis.append(time.perf_counter() - t0)
+    long_hfut.result(timeout=600)
+    deadline = time.monotonic() + 60.0
+    while not long_decode and time.monotonic() < deadline:
+        time.sleep(0.001)
+    long_wire_bytes = long_hfut.result().wire_bytes
+    long_decode[0].result(timeout=600)
+    handoffs_total = pre._handoffs
+    pre.close()
+    dec.close()
+
+    # the split must be invisible in the tokens: the first interactive
+    # prompt, decoded through the tier crossing above, vs an idle
+    # colocated engine (the measured colocated replies ran CONTENDED,
+    # which never changes greedy tokens, but compare against the
+    # cleanest oracle anyway)
+    eng2 = ContinuousGPTEngine(cfg, variables, n_slots=1, **kw)
+    want0 = np.asarray(
+        eng2.submit(int_prompts[0], int_new).result(timeout=600))
+    eng2.close()
+    bitwise = bool(np.array_equal(out_dis, want0))
+
+    # -- handoff-crossing microbench per dtype: prefill resolves the
+    # handoff, then the timed span is wire-codec round trip + queue +
+    # install (max_new=1 resolves the decode Future at install)
+    hand = {}
+    for d in ("fp32", "int8"):
+        pre_d = PrefillWorker(cfg, variables, n_slots=2,
+                              **{**kw, "kv_dtype": d})
+        dec_d = DecodeWorker(cfg, variables, n_slots=2,
+                             **{**kw, "kv_dtype": d})
+        warm_h = pre_d.submit(chunk_warm, 1).result(timeout=600)
+        dec_d.submit_handoff(
+            KVHandoff.from_wire(warm_h.to_wire())).result(timeout=600)
+        times, nbytes = [], []
+        for _ in range(8):
+            p = rng.integers(1, cfg.vocab_size, 256).tolist()
+            h = pre_d.submit(p, 1).result(timeout=600)
+            t0 = time.perf_counter()
+            h2 = KVHandoff.from_wire(h.to_wire())
+            dec_d.submit_handoff(h2).result(timeout=600)
+            times.append(time.perf_counter() - t0)
+            nbytes.append(h.wire_bytes)
+        hand[d] = {"seconds_p50": round(float(np.median(times)), 6),
+                   "bytes_per_handoff": int(np.mean(nbytes))}
+        pre_d.close()
+        dec_d.close()
+    byte_ratio = (hand["fp32"]["bytes_per_handoff"]
+                  / hand["int8"]["bytes_per_handoff"])
+
+    p95_col, p95_dis = pctl(lat_col, 95), pctl(lat_dis, 95)
+    return {
+        "long_prompt_len": long_len,
+        "interactive_requests": n_int,
+        "interactive_new_tokens": int_new,
+        "kv_dtype": dtype,
+        "handoffs": handoffs_total,
+        "long_handoff_bytes": long_wire_bytes,
+        "colocated": {"interactive_p50_ms": pctl(lat_col, 50),
+                      "interactive_p95_ms": p95_col},
+        "disaggregated": {"interactive_p50_ms": pctl(lat_dis, 50),
+                          "interactive_p95_ms": p95_dis},
+        # >1: the tier split kept interactive latency out of the long
+        # prompt's blast radius
+        "decode_p95_colocated_vs_disagg": (
+            round(p95_col / p95_dis, 4) if p95_dis else None),
+        "split_bitwise_vs_colocated": bitwise,
+        "handoff_seconds_p50": hand[dtype]["seconds_p50"],
+        "handoff_bytes": {**hand,
+                          "fp32_over_int8": round(byte_ratio, 4)},
+    }
+
+
 def main() -> None:
     n_replicas = int(os.environ.get("BENCH_REPLICAS", "1"))
     n_sp = int(os.environ.get("BENCH_SP", "2"))
@@ -837,6 +986,11 @@ def main() -> None:
     # AutoScaler-driven ReplicaPool (BENCH_AUTOSCALE=1 enables).
     autoscale = _autoscale_section()
 
+    # Disaggregated prefill/decode (ISSUE 16): long-prompt stream vs
+    # interactive decode, colocated vs split tiers with a quantized
+    # KV-block handoff (BENCH_DISAGG=1 enables).
+    disagg = _disagg_section()
+
     gap = calibrate_dispatch_gap()
     n_dispatches = dispatch_count("serving")
     snap_wall = registry().snapshot().get(
@@ -911,6 +1065,15 @@ def main() -> None:
         "slo_burn_before_after": (autoscale or {}).get(
             "slo_burn_before_after"),
         "autoscale": autoscale,
+        # Disaggregated serving (ISSUE 16): interactive p95 colocated
+        # vs split tiers under a long-prompt stream, the measured
+        # handoff-crossing latency, and the int8-vs-fp32 wire bytes
+        # (None when BENCH_DISAGG != 1)
+        "decode_p95_colocated_vs_disagg": (disagg or {}).get(
+            "decode_p95_colocated_vs_disagg"),
+        "handoff_seconds_p50": (disagg or {}).get("handoff_seconds_p50"),
+        "handoff_bytes": (disagg or {}).get("handoff_bytes"),
+        "disagg": disagg,
         # SLO accounting + flight recorder (ISSUE 9): declared objective
         # with rolling burn, and the event-ring volume this run produced
         "slo": replica_snap.get("slo"),

@@ -34,10 +34,13 @@ must hold for ``hysteresis`` consecutive ticks before anything moves,
 every move is one bounded step followed by ``cooldown_ticks`` of
 quiet, and every scale-DOWN arms an SLO-burn **veto** — burn at or above
 ``veto_burn`` inside ``veto_window_ticks`` reverts the move (a replica
-comes back, parked KV blocks return to service) and puts the direction
-on a ``tabu_ticks`` blocklist. A drained fabric host cannot be
-resurrected by the router, so its veto is tabu-only (documented
-asymmetry; re-provisioning is the operator's half).
+comes back, parked KV blocks return to service, a parked fabric host
+rejoins) and puts the direction on a ``tabu_ticks`` blocklist. The
+fabric tier scales BOTH ways (ISSUE 16, closing the recorded PR 15
+gap): a sustained up-vote with no replica headroom re-opens the most
+recently parked ``spare_hosts`` handle (``InProcessHost.reopen`` →
+``Router.add_host``), bounded by ``max_hosts``; the same rejoin path
+reverts a vetoed host scale-down.
 
 Reliability: ``autoscale.decide`` is a fault site at the top of every
 decision pass, and the actuators carry their own sites
@@ -177,6 +180,7 @@ class AutoscalePolicy:
     tabu_ticks: int = 20
     kv_step_blocks: int = 8
     min_hosts: int = 1
+    max_hosts: int = 8
 
     def __post_init__(self):
         if self.min_replicas < 1:
@@ -199,6 +203,10 @@ class AutoscalePolicy:
         if self.min_hosts < 1:
             raise ValueError(
                 f"min_hosts must be >= 1, got {self.min_hosts}")
+        if self.max_hosts < self.min_hosts:
+            raise ValueError(
+                f"max_hosts {self.max_hosts} < min_hosts "
+                f"{self.min_hosts}")
 
 
 class AutoScaler:
@@ -424,14 +432,41 @@ class AutoScaler:
 
     # -- actuators -----------------------------------------------------------
     def _scale_up(self) -> int:
-        if self.pool is None:
-            return 0
-        if len(self.pool.replicas) >= self.policy.max_replicas:
-            return 0
-        index = self.pool.add_replica(warmup_arrays=self.warmup_arrays)
-        self._record("replica", "up", replica=index,
-                     replicas=len(self.pool.replicas))
-        return 1
+        if self.pool is not None \
+                and len(self.pool.replicas) < self.policy.max_replicas:
+            index = self.pool.add_replica(
+                warmup_arrays=self.warmup_arrays)
+            self._record("replica", "up", replica=index,
+                         replicas=len(self.pool.replicas))
+            return 1
+        if (self.router is not None and self.spare_hosts
+                and len(self.router.hosts()) < self.policy.max_hosts):
+            # fabric-tier scale-UP (ISSUE 16): re-open the most
+            # recently parked handle and rejoin it — the scaler can
+            # grow a tier again, not just shrink it
+            host = self._rejoin_spare_host()
+            if host is not None:
+                self._record("host", "up", host=host,
+                             hosts=len(self.router.hosts()))
+                return 1
+        return 0
+
+    def _rejoin_spare_host(self) -> "str | None":
+        """Reopen the newest ``spare_hosts`` handle and rejoin it via
+        :meth:`Router.add_host`. On failure the handle goes back on the
+        spare list (nothing is half-joined: add_host is the last step)."""
+        handle = self.spare_hosts.pop()
+        try:
+            fn = getattr(handle, "reopen", None)
+            if callable(fn):
+                fn()
+            return self.router.add_host(handle)
+        except Exception:
+            self.spare_hosts.append(handle)
+            _log.warning(
+                "spare-host rejoin failed (handle stays parked)",
+                exc_info=True)
+            return None
 
     def _scale_down(self) -> int:
         pool = self.pool
@@ -538,7 +573,7 @@ class AutoScaler:
     def _veto_all(self, burn: float) -> int:
         """SLO burn spiked inside a scale-down's veto window: revert
         every armed scale-down (replica back in, parked KV blocks back
-        in service; a drained host is tabu-only — see module doc),
+        in service, the parked host handle reopened and rejoined),
         tabu the direction, and read degraded until the cooldown
         recovers."""
         vetoes, self._pending_vetoes = self._pending_vetoes, []
@@ -568,6 +603,13 @@ class AutoScaler:
                         _log.warning("veto revert kv grow failed "
                                      "(tabu still holds)",
                                      exc_info=True)
+            elif actuator == "host" and self.router is not None \
+                    and self.spare_hosts \
+                    and len(self.router.hosts()) < self.policy.max_hosts:
+                # the rejoin path (ISSUE 16) closes the PR 15 tabu-only
+                # asymmetry: a vetoed host scale-down brings the parked
+                # handle back instead of waiting for an operator
+                reverted = self._rejoin_spare_host() is not None
             self._record(actuator, "revert", reverted=reverted,
                          burn=round(burn, 3))
             flight.record_event(

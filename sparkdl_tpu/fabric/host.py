@@ -131,6 +131,11 @@ class InProcessHost(HostHandle):
         if self._drained.is_set():
             raise HostDrainingError(
                 f"host {self.host_id} is draining; route elsewhere")
+        if isinstance(payload, dict) and "handoff" in payload:
+            # cross-tier KV handoff (ISSUE 16): the decode-tier
+            # admission path — installed blocks, no re-prefill
+            return self.engine.submit_handoff(
+                payload["handoff"], timeout_s=timeout_s)
         if self._gpt:
             return self.engine.submit(
                 payload["prompt"], payload["max_new_tokens"],
@@ -180,6 +185,18 @@ class InProcessHost(HostHandle):
     @property
     def draining(self) -> bool:
         return self._drained.is_set()
+
+    def reopen(self) -> None:
+        """Reverse :meth:`drain` (ISSUE 16): a drained handle parked on
+        ``AutoScaler.spare_hosts`` re-enters service — the engine's
+        queue reopens (and its loop restarts if it exited on graceful
+        drain) before the handle rejoins a ``Router.add_host``."""
+        fn = getattr(self.engine, "reopen", None)
+        if callable(fn):
+            fn()
+        else:
+            self.engine.queue.reopen()
+        self._drained.clear()
 
     def requeue(self, requests: "list[Request]") -> None:
         """Adopt requests extracted from ANOTHER host's queue (the

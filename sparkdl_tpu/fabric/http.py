@@ -16,7 +16,12 @@ Server endpoints (:class:`HostServer`, wrapping one engine):
   "timeout_s": t|null}`` → ``{"tokens": [...], "request_id": id}``;
   errors answer non-200 with ``{"error": <type>, "message": ...}`` and
   map back to typed exceptions client-side (429 QueueFull, 503
-  closed/draining, 504 deadline).
+  closed/draining, 504 deadline). Disaggregated tiers (ISSUE 16) ride
+  the same endpoint: a ``{"handoff": <KVHandoff wire dict>}`` body
+  installs on a decode-tier engine, and a prefill-tier engine answers
+  ``{"handoff": ...}`` instead of tokens — the quantized KV blocks
+  cross hosts base64-encoded in their RAW pool storage, so an int8
+  tier's wire cost stays ~4× under fp32's.
 * ``GET /fabric/snapshot`` → ``engine.snapshot()`` (host_id + capacity
   included — the router's weighting input).
 * ``GET /fabric/digest`` → ``engine.prefix_digest()`` (null for dense).
@@ -76,6 +81,18 @@ _ERROR_TYPES = {
     "DeadlineExceededError": (DeadlineExceededError, 504),
     "ValueError": (ValueError, 400),
 }
+
+
+def _register_handoff_errors() -> None:
+    """Add the disagg tier's typed error to the wire map on first
+    handoff use — not at import, so the transport never drags the
+    disagg package (and the model stack behind it) into processes that
+    only route plain prompts. The PhaseRouter's zero-loss requeue keys
+    on the typed re-raise, so it must survive the wire."""
+    if "HandoffInstallError" not in _ERROR_TYPES:
+        from sparkdl_tpu.disagg.handoff import HandoffInstallError
+
+        _ERROR_TYPES["HandoffInstallError"] = (HandoffInstallError, 409)
 
 
 def _status_for(exc: BaseException) -> "tuple[str, int]":
@@ -185,13 +202,22 @@ class HostServer:
         if self.draining:
             raise HostDrainingError(
                 f"host {self.engine.host_id} is draining")
-        prompt = np.asarray(body["prompt"], np.int32)
         timeout_s = body.get("timeout_s")
-        fut = self.engine.submit(
-            prompt, int(body["max_new_tokens"]),
-            timeout_s=float(timeout_s) if timeout_s is not None else None)
+        timeout = float(timeout_s) if timeout_s is not None else None
+        if "handoff" in body:
+            # decode-tier admission (ISSUE 16): install the transferred
+            # blocks, no re-prefill
+            _register_handoff_errors()
+            from sparkdl_tpu.disagg.handoff import KVHandoff
+
+            fut = self.engine.submit_handoff(
+                KVHandoff.from_wire(body["handoff"]), timeout_s=timeout)
+        else:
+            prompt = np.asarray(body["prompt"], np.int32)
+            fut = self.engine.submit(
+                prompt, int(body["max_new_tokens"]), timeout_s=timeout)
         try:
-            tokens = fut.result(timeout=self.result_timeout_s)
+            result = fut.result(timeout=self.result_timeout_s)
         except FuturesTimeoutError:
             # map the backstop to the documented 504/DeadlineExceeded —
             # the raw futures TimeoutError would cross the wire as a
@@ -200,9 +226,13 @@ class HostServer:
             raise DeadlineExceededError(
                 f"generation exceeded the host result backstop "
                 f"({self.result_timeout_s}s)") from None
+        rid = getattr(fut, "request_id", None)
+        if hasattr(result, "to_wire"):
+            # a prefill-tier engine resolves to a KVHandoff: ship it
+            return {"handoff": result.to_wire(), "request_id": rid}
         return {
-            "tokens": [int(t) for t in np.asarray(tokens).ravel()],
-            "request_id": getattr(fut, "request_id", None),
+            "tokens": [int(t) for t in np.asarray(result).ravel()],
+            "request_id": rid,
         }
 
     def handle_drain(self) -> dict:
@@ -313,11 +343,18 @@ class HttpHostHandle(HostHandle):
     def submit(self, payload: "dict[str, Any]", *,
                timeout_s: "float | None" = None) -> Future:
         fault_point("host.submit")
-        body = {
-            "prompt": [int(t) for t in payload["prompt"]],
-            "max_new_tokens": int(payload["max_new_tokens"]),
-            "timeout_s": timeout_s,
-        }
+        if isinstance(payload, dict) and "handoff" in payload:
+            # cross-tier KV transfer (ISSUE 16): serialize the handoff
+            # for the wire; the install failure must re-raise typed
+            _register_handoff_errors()
+            body: dict = {"handoff": payload["handoff"].to_wire(),
+                          "timeout_s": timeout_s}
+        else:
+            body = {
+                "prompt": [int(t) for t in payload["prompt"]],
+                "max_new_tokens": int(payload["max_new_tokens"]),
+                "timeout_s": timeout_s,
+            }
 
         def call():
             out = self._request(
@@ -329,6 +366,11 @@ class HttpHostHandle(HostHandle):
                 timeout_s=((timeout_s if timeout_s is not None
                             else self.result_timeout_s)
                            + self.connect_timeout_s))
+            if "handoff" in out:
+                # a prefill-tier host answered with the exported blocks
+                from sparkdl_tpu.disagg.handoff import KVHandoff
+
+                return KVHandoff.from_wire(out["handoff"])
             return np.asarray(out["tokens"], np.int32)
 
         return self._pool.submit(call)

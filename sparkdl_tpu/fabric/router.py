@@ -142,7 +142,8 @@ class _HostState:
 
     __slots__ = ("handle", "host_id", "outstanding", "routed",
                  "breaker", "draining", "health_status", "digest",
-                 "weight", "saturation")
+                 "weight", "saturation", "free_slots", "kv_free",
+                 "kv_total")
 
     def __init__(self, handle: HostHandle, saturation: "int | None",
                  breaker: ProbationBreaker):
@@ -158,6 +159,12 @@ class _HostState:
         self.digest: "HostDigest | None" = None
         self.weight = 1
         self.saturation = saturation if saturation is not None else 256
+        #: headroom-policy signals off the host's capacity() (None
+        #: until the first refresh, or when the engine has no paged
+        #: pool): slot occupancy + KV availability (ISSUE 16)
+        self.free_slots: "int | None" = None
+        self.kv_free: "int | None" = None
+        self.kv_total: "int | None" = None
 
     # breaker state read-throughs (tests and snapshots read these; all
     # WRITES go through the breaker's transition verbs)
@@ -212,10 +219,10 @@ class Router:
                  session_capacity: int = 4096,
                  refresh_interval_s: float = 2.0,
                  auto_refresh: bool = True):
-        if policy not in ("affinity", "round_robin"):
+        if policy not in ("affinity", "round_robin", "headroom"):
             raise ValueError(
-                f"policy must be 'affinity' or 'round_robin', got "
-                f"{policy!r}")
+                f"policy must be 'affinity', 'round_robin', or "
+                f"'headroom', got {policy!r}")
         if affinity_cap_blocks < 0:
             raise ValueError(
                 f"affinity_cap_blocks must be >= 0, got "
@@ -454,6 +461,33 @@ class Router:
             chosen = open_hosts[self._rr % len(open_hosts)]
             self._rr += 1
             return chosen, False, False
+        if self.policy == "headroom":
+            # decode-tier placement (ISSUE 16): slot headroom discounted
+            # by KV availability — a host with free slots but a nearly
+            # exhausted block pool would only DEFER the installed
+            # request, so it scores like a busy one. The router-side
+            # outstanding count keeps the score live between capacity
+            # refreshes; the load penalty breaks ties the stale
+            # free-slot reading cannot.
+            def room(s: _HostState) -> float:
+                free = (s.free_slots if s.free_slots is not None
+                        else s.weight)
+                free = max(0.0, free - s.outstanding)
+                kv = 1.0
+                if s.kv_total:
+                    kv = max(0.0, s.kv_free or 0) / s.kv_total
+                return free * kv
+
+            scores = {
+                s.host_id: (room(s)
+                            - self.load_weight * s.outstanding / s.weight)
+                for s in candidates}
+            best_score = max(scores[s.host_id] for s in open_hosts)
+            ties = [s for s in open_hosts
+                    if scores[s.host_id] == best_score]
+            chosen = ties[self._rr % len(ties)]
+            self._rr += 1
+            return chosen, max(scores.values()) > best_score, False
         # score each host exactly once (nothing can change under the
         # held lock): the digest walks are the lock's hot-path cost
         bonuses = {s.host_id: bonus(s) for s in candidates}
@@ -616,6 +650,12 @@ class Router:
             state.weight = weight
             state.saturation = saturation
             state.digest = digest
+            fs = cap.get("free_slots")
+            state.free_slots = int(fs) if fs is not None else None
+            kf = cap.get("kv_blocks_free")
+            state.kv_free = int(kf) if kf is not None else None
+            kt = cap.get("kv_blocks_total")
+            state.kv_total = int(kt) if kt is not None else None
             state.health_status = str(
                 health.get("status") or "ok")
             # gauge published under the same lock as the membership
@@ -679,6 +719,17 @@ class Router:
                         break
                 time.sleep(0.01)
         return moved
+
+    def requeue(self, reqs: "list[Request]") -> int:
+        """Public transfer entry (ISSUE 16): hand already-accepted
+        :class:`Request` objects to this fabric — the cross-TIER half
+        of the drain contract. A :class:`~sparkdl_tpu.disagg.PhaseRouter`
+        whose decode tier lost a KV handoff re-queues the victim here,
+        at the chosen host's queue HEAD (``RequestQueue.requeue``), so
+        it re-prefills ahead of later arrivals — zero accepted requests
+        lost. Returns the number placed; unplaceable requests fail with
+        the placement error, counted once."""
+        return self._requeue_requests(reqs)
 
     def _requeue_requests(self, reqs: "list[Request]") -> int:
         """Hand drained :class:`Request` objects to surviving hosts:
@@ -847,6 +898,14 @@ class Router:
     def hosts(self) -> "list[str]":
         return list(self._hosts)
 
+    def host_handles(self) -> "list[HostHandle]":
+        """Live handles (ISSUE 16): tier-level aggregations — e.g. the
+        PhaseRouter's per-tier depth gauge and the per-tier autoscaler
+        signal readers — poll ``capacity()`` across the fleet without
+        reaching into router internals."""
+        with self._lock:
+            return [s.handle for s in self._hosts.values()]
+
     def snapshot(self) -> "dict[str, Any]":
         """Operator/postmortem view. Exposes ``replica_count`` /
         ``healthy_count`` in the pool shape ``healthz_report()``
@@ -864,6 +923,9 @@ class Router:
                     "quarantined": s.quarantined,
                     "draining": s.draining,
                     "health": s.health_status,
+                    "free_slots": s.free_slots,
+                    "kv_free": s.kv_free,
+                    "kv_total": s.kv_total,
                     "consecutive_failures": s.consecutive_failures,
                     "digest_blocks": (len(s.digest.hashes)
                                       if s.digest is not None else 0),

@@ -83,6 +83,8 @@ KNOWN_SITES = (
     "router.route",
     "host.submit",
     "host.drain",
+    "handoff.export",
+    "handoff.install",
     "worker.rank",
 )
 

@@ -1005,7 +1005,9 @@ class ContinuousGPTEngine:
                     f"{self.max_len}: raise max_len or shorten the "
                     "request"
                 )
-            need = -(-(len(prompt) + max_new_tokens) // self._kv_bs)
+            need = -(-(len(prompt)
+                       + self._admission_budget_tokens(max_new_tokens))
+                     // self._kv_bs)
             if need > self._pool.n_blocks:
                 raise ValueError(
                     f"request needs {need} KV blocks but the pool holds "
@@ -1033,6 +1035,15 @@ class ContinuousGPTEngine:
         return self.queue.submit(
             GenRequest(prompt, max_new_tokens), timeout_s=timeout_s
         )
+
+    def _admission_budget_tokens(self, max_new_tokens: int) -> int:
+        """Decode-side tokens a paged admission must reserve blocks for
+        beyond the prompt. The colocated engine reserves the FULL token
+        budget up front (decode can never hit mid-stream exhaustion);
+        a prefill-tier worker (:mod:`sparkdl_tpu.disagg`) overrides this
+        to 0 — it only ever holds prompt K/V, the decode tier owns the
+        generation span."""
+        return max_new_tokens
 
     # -- engine loop ---------------------------------------------------------
     def start(self) -> "ContinuousGPTEngine":
@@ -1086,6 +1097,21 @@ class ContinuousGPTEngine:
             host=self.host_id, extracted=len(reqs),
             inflight=len(self._inflight) + len(self._prefilling))
         return reqs
+
+    def reopen(self) -> "ContinuousGPTEngine":
+        """Reverse :meth:`begin_drain` (ISSUE 16): accept submits again
+        and, if the loop thread exited on graceful drain, restart it —
+        the spare-host rejoin path (an AutoScaler that parked a drained
+        handle puts it back in service through ``Router.add_host``).
+        Only for engines that were DRAINED, never CLOSED: close() tears
+        down pools and observability, which do not come back."""
+        self._stop.clear()
+        self.queue.reopen()
+        t = self._thread
+        if t is not None and not t.is_alive():
+            self._thread = None
+            self.start()
+        return self
 
     def prefix_digest(self, max_entries: int = 1024) -> "dict | None":
         """The compact prefix→host digest this host publishes
@@ -1214,7 +1240,8 @@ class ContinuousGPTEngine:
         # admission would still defer on). Staging holds prompt blocks
         # only; the decode pool the full prompt + budget span.
         span = (len(gen.prompt) if staging
-                else len(gen.prompt) + gen.max_new_tokens)
+                else len(gen.prompt)
+                + self._admission_budget_tokens(gen.max_new_tokens))
         pool.record_deferral(need=-(-span // self._kv_bs))
         streak = pool.deferral_streak
         flight_mod.record_event(
@@ -1285,7 +1312,9 @@ class ContinuousGPTEngine:
         prompt = np.asarray(gen.prompt, np.int32)
         plen = len(prompt)
         toks = tuple(int(t) for t in prompt)
-        nb_total = -(-(plen + gen.max_new_tokens) // self._kv_bs)
+        nb_total = -(-(plen
+                       + self._admission_budget_tokens(gen.max_new_tokens))
+                     // self._kv_bs)
         # the last prompt token must always prefill — the cache holds
         # K/V, not the logits that seed decode
         m = self._prefix.match(toks[:-1])

@@ -355,6 +355,39 @@ class RequestQueue:
             self._update_depth_locked()
             self._cv.notify_all()
 
+    def adopt(self, req: Request) -> None:
+        """Enqueue an ALREADY-ACCEPTED request at the tail — the
+        cross-tier admission primitive (ISSUE 16): a decode tier adopts
+        a request whose prefill finished on another tier. Unlike
+        :meth:`submit` there is no depth check and no new Future — the
+        request was admitted (and counted) once, at the prefill tier's
+        front door, and re-rejecting accepted traffic would break the
+        zero-loss contract exactly like re-rejecting a transfer would
+        (see :meth:`requeue`). Unlike :meth:`requeue` the request joins
+        at the TAIL: it is new work for THIS tier, not deferred work
+        this tier owes. Raises :class:`EngineClosedError` on a closed
+        queue — a HOST-level error, so a router fails over to another
+        decode host instead of losing the handoff."""
+        with self._cv:
+            if self._closed:
+                raise EngineClosedError(
+                    "queue is closed to new requests")
+            self._dq.append(req)
+            self.submitted += 1
+            _M_SUBMITTED.inc()
+            self._update_depth_locked()
+            self._cv.notify()
+
+    def reopen(self) -> None:
+        """Reverse :meth:`close`: accept new submits again — the
+        spare-host rejoin path (ISSUE 16): a handle drained and parked
+        by the autoscaler re-enters service via ``Router.add_host``.
+        Only meaningful while the owning engine's loop is still (or
+        again) running; queued state is untouched."""
+        with self._cv:
+            self._closed = False
+            self._cv.notify_all()
+
     def extract_pending(self) -> "list[Request]":
         """Remove and return every queued request WITHOUT resolving its
         Future — the drain/transfer primitive (ISSUE 14): a draining or

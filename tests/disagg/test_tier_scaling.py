@@ -1,0 +1,280 @@
+"""Per-tier autoscaling (ISSUE 16): each tier scales on its own
+pressure signal, and the fabric tier now scales BOTH ways — the PR 15
+gap: a drained host parked on ``AutoScaler.spare_hosts`` rejoins via
+``reopen`` + ``Router.add_host`` on a sustained up-vote or a veto
+revert, instead of waiting for an operator."""
+
+import time
+from concurrent.futures import Future
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.autoscale.controller import AutoscalePolicy, AutoScaler
+from sparkdl_tpu.disagg import (
+    PhaseRouter,
+    PrefillWorker,
+    decode_tier_signals,
+    prefill_tier_signals,
+    tier_autoscalers,
+)
+from sparkdl_tpu.fabric import HostHandle
+from sparkdl_tpu.fabric.host import InProcessHost
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    return cfg, variables
+
+
+def _kw(**over):
+    kw = dict(n_slots=2, max_len=40, auto_start=False,
+              kv_block_size=4, prefill_chunk=8)
+    kw.update(over)
+    return kw
+
+
+class StubHost(HostHandle):
+    """Capacity/health a test mutates; tracks drain/reopen calls."""
+
+    def __init__(self, host_id, *, free_slots=2, n_slots=2,
+                 queue_depth=0):
+        self.host_id = host_id
+        self.free_slots = free_slots
+        self.n_slots = n_slots
+        self.queue_depth = queue_depth
+        self.status = "ok"
+        self.reopened = 0
+        self.drained = 0
+
+    def submit(self, payload, *, timeout_s=None):
+        fut = Future()
+        fut.set_result(self.host_id)
+        return fut
+
+    def capacity(self):
+        return {"host_id": self.host_id, "replica_count": 1,
+                "n_slots": self.n_slots,
+                "free_slots": self.free_slots,
+                "kv_blocks_free": 8, "kv_blocks_total": 8,
+                "queue_depth": self.queue_depth,
+                "max_queue_depth": 16, "draining": False}
+
+    def health(self):
+        return {"status": self.status, "host_id": self.host_id}
+
+    def snapshot(self):
+        return {"host_id": self.host_id, "capacity": self.capacity()}
+
+    def prefix_digest(self, max_entries=1024):
+        return None
+
+    def drain(self):
+        self.drained += 1
+        return []
+
+    def reopen(self):
+        self.reopened += 1
+
+    def close(self, *, timeout_s=30.0):
+        pass
+
+
+def _stub_phase_router(n_prefill=1, n_decode=2):
+    pre = [StubHost(f"p{i}") for i in range(n_prefill)]
+    dec = [StubHost(f"d{i}") for i in range(n_decode)]
+    return PhaseRouter(pre, dec, auto_refresh=False), pre, dec
+
+
+def _policy(**over):
+    kw = dict(hysteresis=1, cooldown_ticks=0, tabu_ticks=2,
+              queue_high=2.0, queue_low=0.5)
+    kw.update(over)
+    return AutoscalePolicy(**kw)
+
+
+# -- signal readers ------------------------------------------------------------
+
+def test_prefill_signal_is_the_tier_queue_depth(bundle):
+    """Live engines: queued-but-unstarted prompts ARE prefill
+    pressure; the burn channel stays quiet (the latency objective
+    lives on the decode tier)."""
+    cfg, variables = bundle
+    pre = PrefillWorker(cfg, variables, **_kw(n_slots=1))
+    pr = PhaseRouter([InProcessHost(pre, host_id="p0")],
+                     [StubHost("d0")], auto_refresh=False)
+    read = prefill_tier_signals(pr)
+    try:
+        assert read() == (0.0, 0.0)
+        futs = [pre.submit([1, 2, 3], 2) for _ in range(3)]
+        depth, burn = read()
+        assert depth == 3.0 and burn == 0.0
+        while not all(f.done() for f in futs):
+            pre.tick()
+        assert read() == (0.0, 0.0)
+    finally:
+        pr.close()
+        pre.close()
+
+
+def test_decode_signal_counts_occupancy_plus_queued_handoffs():
+    spr, _, dec = _stub_phase_router(n_decode=2)
+    read = decode_tier_signals(spr)
+    try:
+        assert read() == (0.0, 0.0)
+        dec[0].free_slots = 0      # 2 slots camped on
+        dec[1].queue_depth = 3     # 3 handoffs waiting
+        pressure, burn = read()
+        assert pressure == 5.0 and burn == 0.0
+    finally:
+        spr.close()
+
+
+def test_decode_burn_saturates_on_kv_exhaustion_health():
+    """A degraded host (what a KV deferral streak sets) maps to
+    burn=1.0 — block starvation scales the tier up even while slots
+    look free."""
+    spr, _, dec = _stub_phase_router(n_decode=2)
+    read = decode_tier_signals(spr)
+    try:
+        dec[1].status = "degraded"
+        pressure, burn = read()
+        assert pressure == 0.0 and burn == 1.0
+        dec[1].status = "ok"
+        assert read() == (0.0, 0.0)
+    finally:
+        spr.close()
+
+
+# -- fabric-tier scale-down / scale-up (the PR 15 gap) ------------------------
+
+def test_scale_down_parks_then_pressure_rejoins_the_spare_host():
+    """The full round trip on one tier: quiet signals drain + park a
+    host as spare capacity; sustained pressure re-opens it and rejoins
+    via Router.add_host — the scaler grows the tier again, not just
+    shrinks it."""
+    spr, pre_hosts, _ = _stub_phase_router(n_prefill=2)
+    depth = [0.0]
+    scaler = AutoScaler(router=spr.prefill, policy=_policy(),
+                        signals=lambda: (depth[0], 0.0))
+    try:
+        assert scaler.tick() == 1  # quiet -> park one host
+        assert len(spr.prefill.hosts()) == 1
+        assert len(scaler.spare_hosts) == 1
+        parked = scaler.spare_hosts[0]
+        assert parked.drained == 1
+        scaler.tick()  # still quiet, but min_hosts floors the tier
+        assert len(scaler.spare_hosts) == 1
+        depth[0] = 8.0  # a burst: 8 queued vs queue_high=2
+        assert scaler.tick() == 1  # up-vote -> reopen + add_host
+        assert len(spr.prefill.hosts()) == 2
+        assert not scaler.spare_hosts
+        assert parked.reopened == 1
+        # the rejoined host routes again
+        spr.prefill.refresh()
+        assert parked.host_id in spr.prefill.hosts()
+    finally:
+        scaler.close()
+        spr.close()
+
+
+def test_rejoined_live_host_serves_requests_again(bundle):
+    """Engine-backed round trip: park a real InProcessHost, rejoin it,
+    and verify it actually SERVES — reopen restarts the drained
+    engine's queue before add_host exposes it to placement."""
+    cfg, variables = bundle
+    engines = [PrefillWorker(cfg, variables, host_id=f"p{i}",
+                             **_kw(auto_start=True)) for i in range(2)]
+    hosts = [InProcessHost(e, host_id=e.host_id) for e in engines]
+    pr = PhaseRouter(hosts, [StubHost("d0")], auto_refresh=False)
+    depth = [0.0]
+    scaler = AutoScaler(router=pr.prefill, policy=_policy(),
+                        signals=lambda: (depth[0], 0.0))
+    try:
+        assert scaler.tick() == 1
+        (parked,) = scaler.spare_hosts
+        assert parked.draining
+        depth[0] = 8.0
+        assert scaler.tick() == 1
+        assert not parked.draining  # reopen reversed the drain
+        assert len(pr.prefill.hosts()) == 2
+        # the tier still prefills end to end through both hosts
+        futs = [pr.prefill.submit(
+            {"prompt": [1, 2, 3, i], "max_new_tokens": 2})
+            for i in range(4)]
+        handoffs = [f.result(timeout=30) for f in futs]
+        assert all(h.n_blocks >= 1 for h in handoffs)
+    finally:
+        scaler.close()
+        pr.close()
+        for e in engines:
+            e.close()
+
+
+def test_veto_revert_rejoins_the_parked_decode_host():
+    """A scale-down whose veto window sees SLO burn (here: KV
+    exhaustion flipping a survivor to degraded) REVERTS — the parked
+    handle comes back instead of the tier limping until an operator
+    notices."""
+    spr, _, dec = _stub_phase_router(n_decode=2)
+    scaler = AutoScaler(router=spr.decode,
+                        policy=_policy(veto_window_ticks=3),
+                        signals=decode_tier_signals(spr))
+    try:
+        assert scaler.tick() == 1  # quiet -> park one decode host
+        assert len(scaler.spare_hosts) == 1
+        parked = scaler.spare_hosts[0]
+        survivor = dec[0] if dec[1] is parked else dec[1]
+        survivor.status = "degraded"  # exhaustion inside the window
+        assert scaler.tick() >= 1  # veto fires -> revert rejoins
+        assert not scaler.spare_hosts
+        assert parked.reopened == 1
+        assert len(spr.decode.hosts()) == 2
+        snap = scaler.snapshot()["autoscaler"]
+        assert snap["hosts"] == 2 and snap["spare_hosts"] == 0
+    finally:
+        scaler.close()
+        spr.close()
+
+
+def test_min_hosts_floors_the_tier():
+    spr, _, _ = _stub_phase_router(n_prefill=1)
+    scaler = AutoScaler(router=spr.prefill,
+                        policy=_policy(min_hosts=1),
+                        signals=lambda: (0.0, 0.0))
+    try:
+        for _ in range(4):
+            scaler.tick()
+        assert len(spr.prefill.hosts()) == 1
+        assert not scaler.spare_hosts
+    finally:
+        scaler.close()
+        spr.close()
+
+
+def test_tier_autoscalers_binds_one_scaler_per_tier():
+    spr, _, dec = _stub_phase_router(n_prefill=1, n_decode=2)
+    pre_s, dec_s = tier_autoscalers(
+        spr, prefill_policy=_policy(), decode_policy=_policy())
+    try:
+        assert pre_s.router is spr.prefill
+        assert dec_s.router is spr.decode
+        # each scaler reads ITS tier: decode pressure is invisible to
+        # the prefill scaler's signal channel
+        dec[0].free_slots = 0
+        dec[0].queue_depth = 4
+        assert pre_s._signals()[0] == 0.0
+        assert dec_s._signals()[0] >= 6.0
+    finally:
+        pre_s.close()
+        dec_s.close()
+        spr.close()
