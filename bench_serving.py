@@ -704,7 +704,12 @@ def _disagg_section():
     ratio, the measured handoff-crossing latency p50 (wire codec +
     transfer + install, max_new=1 so the Future resolves AT install),
     and fp32-vs-int8 wire bytes — the int8 pool's storage IS the wire
-    format, so the crossing inherits its ~4x compression."""
+    format, so the crossing inherits its ~4x compression. Also emits
+    ``phase_breakdown`` (ISSUE 17): per-phase median seconds (queue
+    wait / prefill compute / handoff wire / decode queue / decode
+    compute) read off the registry's sparkdl_request_phase_seconds
+    histograms — summed, the p50s reconstruct the measured interactive
+    e2e median."""
     if os.environ.get("BENCH_DISAGG", "0") != "1":
         return None
     import jax
@@ -779,6 +784,33 @@ def _disagg_section():
     pre.close()
     dec.close()
 
+    # Per-request phase attribution (ISSUE 17): the decode tier observed
+    # every crossing into sparkdl_request_phase_seconds{phase,tier} —
+    # read the per-phase medians NOW, before the dtype microbench below
+    # floods the same histograms with max_new=1 crossings. The p50s
+    # telescope: summed, they reconstruct the median interactive e2e
+    # latency measured client-side above.
+    from sparkdl_tpu.observability.registry import registry
+
+    _PHASE_ORDER = {("queue", "prefill"): 0, ("compute", "prefill"): 1,
+                    ("wire", "handoff"): 2, ("queue", "decode"): 3,
+                    ("compute", "decode"): 4}
+    fam = registry().get("sparkdl_request_phase_seconds")
+    phase_rows = [
+        {"phase": labels.get("phase"), "tier": labels.get("tier"),
+         "p50_s": round(stats["p50"], 6),
+         "mean_s": round(stats["mean"], 6),
+         "observations": stats["count"]}
+        for labels, stats in (fam.hist_series() if fam else [])
+    ]
+    phase_rows.sort(key=lambda r: _PHASE_ORDER.get(
+        (r["phase"], r["tier"]), 99))
+    phase_breakdown = {
+        "phases": phase_rows,
+        "sum_p50_s": round(sum(r["p50_s"] for r in phase_rows), 6),
+        "interactive_p50_s": round(float(np.median(lat_dis)), 6),
+    } if phase_rows else None
+
     # the split must be invisible in the tokens: the first interactive
     # prompt, decoded through the tier crossing above, vs an idle
     # colocated engine (the measured colocated replies ran CONTENDED,
@@ -838,6 +870,11 @@ def _disagg_section():
         "handoff_seconds_p50": hand[dtype]["seconds_p50"],
         "handoff_bytes": {**hand,
                           "fp32_over_int8": round(byte_ratio, 4)},
+        # per-phase latency attribution (ISSUE 17), registry-sourced:
+        # median seconds in queue-wait / prefill compute / handoff wire
+        # / decode queue / decode compute — summed, the p50s reconstruct
+        # the interactive e2e median
+        "phase_breakdown": phase_breakdown,
     }
 
 
@@ -1073,6 +1110,10 @@ def main() -> None:
             "decode_p95_colocated_vs_disagg"),
         "handoff_seconds_p50": (disagg or {}).get("handoff_seconds_p50"),
         "handoff_bytes": (disagg or {}).get("handoff_bytes"),
+        # Per-request phase attribution (ISSUE 17): registry-sourced
+        # median seconds per phase; the p50s telescope to the
+        # interactive e2e median (None when BENCH_DISAGG != 1)
+        "phase_breakdown": (disagg or {}).get("phase_breakdown"),
         "disagg": disagg,
         # SLO accounting + flight recorder (ISSUE 9): declared objective
         # with rolling burn, and the event-ring volume this run produced

@@ -491,6 +491,82 @@ print(f"disagg smoke OK: {len(cases)}/8 bitwise across the int8 split "
       f"export aborts={aborts}, zero lost, counters reconcile)")
 EOF
 
+# Cross-host trace-stitching smoke (ISSUE 17): the split request over
+# the REAL HTTP transport — a prefill-tier HostServer and a decode-tier
+# HostServer on separate ports behind a PhaseRouter of HttpHostHandles.
+# The serialized SpanContext rides the submit body and the KVHandoff
+# wire dict, so fleet_trace(request_id) resolves to ONE stitched trace:
+# BOTH tiers' spans, exactly one handoff.wire crossing, clock offsets
+# estimated for both hosts, and a five-phase breakdown telescoping to
+# the measured end-to-end latency.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import time
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.disagg import DecodeWorker, PhaseRouter, PrefillWorker
+from sparkdl_tpu.fabric.http import HostServer, HttpHostHandle
+from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+from sparkdl_tpu.observability import tracing
+from sparkdl_tpu.observability.fleet import PHASES, FleetScraper
+
+tracing.clear_trace()
+tracing.enable_tracing()
+cfg = GPTConfig.tiny()
+model = GPTLMHeadModel(cfg)
+variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+KW = dict(n_slots=2, max_len=48, kv_block_size=4, prefill_chunk=8,
+          kv_dtype="int8", kv_layout="paged")
+pre = PrefillWorker(cfg, variables, host_id="pre-0", **KW)
+dec = DecodeWorker(cfg, variables, host_id="dec-0", **KW)
+srv_p = HostServer(pre)
+srv_d = HostServer(dec)
+pr = PhaseRouter(
+    [HttpHostHandle(f"http://127.0.0.1:{srv_p.port}", host_id="pre-0")],
+    [HttpHostHandle(f"http://127.0.0.1:{srv_d.port}", host_id="dec-0")],
+    auto_refresh=False)
+try:
+    t0 = time.monotonic()
+    out = np.asarray(pr.submit(list(range(1, 11)), 4).result(timeout=120))
+    e2e = time.monotonic() - t0
+    assert len(out) == 4, out
+
+    wire = [e for e in tracing.trace_events()
+            if e["name"] == "handoff.wire"]
+    assert len(wire) == 1, sorted(
+        {e["name"] for e in tracing.trace_events()})
+    rid = wire[0]["args"]["request_id"]
+
+    scraper = FleetScraper.from_phase_router(pr)
+    assert scraper.tier_of("pre-0") == "prefill"
+    assert scraper.tier_of("dec-0") == "decode"
+    stitched = scraper.fleet_trace(rid)
+    names = [e["name"] for e in stitched["spans"]]
+    assert names.count("handoff.wire") == 1, names
+    assert "disagg.handoff_export" in names, names   # prefill tier ran
+    assert "disagg.handoff_install" in names, names  # decode tier ran
+    assert names.index("disagg.handoff_export") \
+        < names.index("handoff.wire"), names
+    # both hosts answered the offset probes; one process, so ~zero skew
+    offs = scraper.clock_offsets()
+    assert set(offs) == {"pre-0", "dec-0"}, offs
+    assert all(abs(o) < 1e6 for o in offs.values()), offs
+    phases = stitched["phases"]
+    assert [(p["phase"], p["tier"]) for p in phases] == list(PHASES), \
+        phases
+    total = sum(p["seconds"] for p in phases)
+    assert total > 0, phases
+    assert abs(total - e2e) < 0.25 * e2e + 0.1, (total, e2e)
+finally:
+    pr.close()
+    srv_p.close(); srv_d.close()
+    pre.close(); dec.close()
+    tracing.disable_tracing(); tracing.clear_trace()
+print(f"disagg-trace smoke OK: split request over HTTP stitched to ONE "
+      f"trace ({len(names)} spans, 1 handoff.wire crossing), phases "
+      f"{total:.3f}s vs e2e {e2e:.3f}s")
+EOF
+
 # Online serving bench: same one-JSON-line contract; vs_baseline is the
 # micro-batch / batch-of-1 throughput ratio under open-loop Poisson load.
 # BENCH_SPEC_K/BENCH_KV_DTYPE are pinned: the contract below asserts the
@@ -610,8 +686,24 @@ assert dg["handoffs"] >= dg["interactive_requests"], dg
 assert "sparkdl_disagg_handoffs_total" in obs, sorted(obs)
 assert "sparkdl_disagg_handoff_bytes_total" in obs, sorted(obs)
 assert "sparkdl_disagg_handoff_seconds" in obs, sorted(obs)
+# ISSUE 17: per-phase latency attribution — all five phases observed
+# with non-zero medians, registry-sourced, and the p50s telescope to
+# the measured interactive e2e median (generous bound: histogram
+# percentiles are bucket-interpolated and the warmup/long-prompt
+# crossings ride the same series)
+pb = rec["phase_breakdown"]
+assert pb is not None, "phase_breakdown missing from disagg artifact"
+assert [(r["phase"], r["tier"]) for r in pb["phases"]] == [
+    ("queue", "prefill"), ("compute", "prefill"), ("wire", "handoff"),
+    ("queue", "decode"), ("compute", "decode")], pb
+assert all(r["observations"] > 0 for r in pb["phases"]), pb
+assert all(r["p50_s"] > 0 for r in pb["phases"]), pb
+assert pb["interactive_p50_s"] > 0, pb
+assert abs(pb["sum_p50_s"] - pb["interactive_p50_s"]) <= \
+    0.5 * pb["interactive_p50_s"] + 0.05, pb
+assert "sparkdl_request_phase_seconds" in obs, sorted(obs)
 print("bench_serving contract OK (snapshot + slo + flight + kv + spec "
-      "+ sp + fabric + autoscale + disagg embedded)")
+      "+ sp + fabric + autoscale + disagg + phases embedded)")
 '
 
 # Paged-KV smoke (ISSUE 10): (a) a shared-prefix workload through the

@@ -18,11 +18,18 @@ quantized pool's wire economics for free:
   have prefilled itself, and greedy tokens cannot drift across the
   split.
 
-Identity crosses with the data: ``request_id`` (= trace id), the
-absolute deadline (re-anchored as remaining seconds over the HTTP
-transport — monotonic clocks do not cross processes), and the original
-enqueue stamp, so latency accounting and the zero-loss requeue contract
-see ONE request end to end. The object duck-types
+Identity crosses with the data: ``request_id`` (= trace id, fleet-unique
+since ISSUE 17), the serialized trace ``SpanContext`` (so decode-tier
+spans parent into the SAME trace the prefill tier started), the live
+flight-recorder ``incident_id`` if any (so both tiers' postmortem
+bundles join on one incident), the absolute deadline (re-anchored as
+remaining seconds over the HTTP transport — monotonic clocks do not
+cross processes; the export stamp re-anchors the same way, as elapsed
+age), and the original enqueue stamp, so latency accounting and the
+zero-loss requeue contract see ONE request end to end. The prefill
+tier's measured ``queue_wait_s``/``prefill_s`` ship as DURATIONS (clock-
+safe), feeding the decode-side per-request phase attribution
+(``sparkdl_request_phase_seconds{phase,tier}``). The object duck-types
 :class:`~sparkdl_tpu.serving.continuous.GenRequest`
 (``.prompt``/``.max_new_tokens``), so the decode engine's deferral path
 treats an adopted handoff like any admitted request.
@@ -37,9 +44,10 @@ from typing import Any
 
 import numpy as np
 
+from sparkdl_tpu.observability import tracing
 from sparkdl_tpu.observability.registry import registry
 
-__all__ = ["HandoffInstallError", "KVHandoff"]
+__all__ = ["HandoffInstallError", "KVHandoff", "observe_phase"]
 
 _M_HANDOFFS = registry().counter(
     "sparkdl_disagg_handoffs_total",
@@ -60,6 +68,23 @@ _M_TIER_DEPTH = registry().gauge(
     "sparkdl_disagg_tier_depth",
     "queued requests per disaggregated serving tier",
     labels=("tier",))
+_M_PHASE_SECONDS = registry().histogram(
+    "sparkdl_request_phase_seconds",
+    "per-request latency attribution (ISSUE 17): where one request's "
+    "wall time went — (queue,prefill) submit→take, (compute,prefill) "
+    "take→export, (wire,handoff) export→decode-tier arrival, "
+    "(queue,decode) arrival→admit, (compute,decode) admit→done. The "
+    "five phases telescope: their sum IS the request's end-to-end "
+    "latency (asserted by run-tests.sh)",
+    labels=("phase", "tier"))
+
+
+def observe_phase(phase: str, tier: str, seconds: float) -> None:
+    """Record one request's time in one phase (clamped at 0 — phase
+    boundaries are monotonic stamps, but cross-process re-anchoring can
+    produce a negative hairline)."""
+    _M_PHASE_SECONDS.observe(max(0.0, float(seconds)),
+                             phase=phase, tier=tier)
 
 
 class HandoffInstallError(RuntimeError):
@@ -114,6 +139,22 @@ class KVHandoff:
     enqueued: float = 0.0
     trace_ctx: Any = None
     src_host: "str | None" = None
+    #: monotonic stamp (LOCAL clock) of export completion on the
+    #: prefill tier; re-anchored as elapsed age over the wire, exactly
+    #: like the deadline — the ``handoff.wire`` span's start
+    exported_at: "float | None" = None
+    #: monotonic stamp (LOCAL clock) of arrival on the decode tier
+    #: (``from_wire``/``submit_handoff``): the wire→decode-queue phase
+    #: boundary
+    arrived_at: "float | None" = None
+    #: prefill-tier measured durations (clock-safe across processes):
+    #: submit→take and take→export — the decode side publishes all five
+    #: request phases from one place using these
+    queue_wait_s: float = 0.0
+    prefill_s: float = 0.0
+    #: live flight-recorder incident id at export time (ISSUE 17): the
+    #: decode tier adopts it so both tiers' postmortem bundles join
+    incident_id: "str | None" = None
 
     @property
     def n_blocks(self) -> int:
@@ -131,8 +172,11 @@ class KVHandoff:
     def to_wire(self) -> dict:
         """JSON-safe dict (base64 tensors) for the ``HostServer``
         transport. The absolute monotonic deadline ships as REMAINING
-        seconds and re-anchors on arrival; ``trace_ctx`` does not cross
-        processes (the request id, which is the trace id, does)."""
+        seconds and re-anchors on arrival; ``exported_at`` ships the
+        same way (as elapsed ``export_age_s``); ``trace_ctx`` crosses
+        as a serialized :class:`~sparkdl_tpu.observability.tracing.
+        SpanContext` so decode-tier spans parent into the prefill
+        tier's trace (ISSUE 17)."""
         out = {
             "prompt": [int(t) for t in self.prompt],
             "max_new_tokens": int(self.max_new_tokens),
@@ -143,10 +187,20 @@ class KVHandoff:
             "v": _enc(self.v),
             "request_id": int(self.request_id),
             "src_host": self.src_host,
+            "queue_wait_s": float(self.queue_wait_s),
+            "prefill_s": float(self.prefill_s),
         }
+        trace = tracing.context_to_wire(self.trace_ctx)
+        if trace is not None:
+            out["trace"] = trace
+        if self.incident_id:
+            out["incident_id"] = str(self.incident_id)
         if self.deadline is not None:
             out["remaining_s"] = max(
                 0.0, self.deadline - time.monotonic())
+        if self.exported_at is not None:
+            out["export_age_s"] = max(
+                0.0, time.monotonic() - self.exported_at)
         if self.k_scale is not None:
             out["k_scale"] = _enc(self.k_scale)
             out["v_scale"] = _enc(self.v_scale)
@@ -154,9 +208,13 @@ class KVHandoff:
 
     @classmethod
     def from_wire(cls, d: dict) -> "KVHandoff":
+        now = time.monotonic()
         deadline = None
         if "remaining_s" in d:
-            deadline = time.monotonic() + float(d["remaining_s"])
+            deadline = now + float(d["remaining_s"])
+        exported_at = None
+        if "export_age_s" in d:
+            exported_at = now - float(d["export_age_s"])
         return cls(
             prompt=np.asarray(d["prompt"], np.int32),
             max_new_tokens=int(d["max_new_tokens"]),
@@ -169,6 +227,12 @@ class KVHandoff:
             v_scale=_dec(d["v_scale"]) if "v_scale" in d else None,
             request_id=int(d.get("request_id") or 0),
             deadline=deadline,
-            enqueued=time.monotonic(),
+            enqueued=now,
+            trace_ctx=tracing.context_from_wire(d.get("trace")),
             src_host=d.get("src_host"),
+            exported_at=exported_at,
+            arrived_at=now,
+            queue_wait_s=float(d.get("queue_wait_s") or 0.0),
+            prefill_s=float(d.get("prefill_s") or 0.0),
+            incident_id=d.get("incident_id"),
         )

@@ -187,6 +187,13 @@ class PhaseRouter:
         flight.record_event(
             "disagg.handoff_requeued", request_id=h.request_id,
             error=type(exc).__name__, retries_left=retries_left)
+        # postmortem trigger (ISSUE 17): a lost crossing is an incident
+        # — the dump's incident_id joins this tier's bundle with the
+        # prefill tier's (the id rode the handoff wire, or mints here
+        # and rides the NEXT export within the TTL)
+        flight.trigger_dump(
+            "disagg.handoff_lost", request_id=h.request_id,
+            error=type(exc).__name__, src_host=h.src_host)
         inner: Future = Future()
         inner.request_id = h.request_id
         inner.set_running_or_notify_cancel()

@@ -52,6 +52,7 @@ from sparkdl_tpu.disagg.handoff import (
     _M_HANDOFFS,
     HandoffInstallError,
     KVHandoff,
+    observe_phase,
 )
 
 __all__ = ["DecodeWorker", "PrefillWorker"]
@@ -139,6 +140,13 @@ class PrefillWorker(ContinuousGPTEngine):
         _M_HANDOFF_SECONDS.observe(time.perf_counter() - t0)
         del self._prefilling[slot]
         self._prefix.release(blocks)
+        # phase boundaries (ISSUE 17): the export stamp ends this
+        # tier's work; queue/prefill ship as measured DURATIONS so the
+        # decode tier can publish all five phases without sharing a
+        # clock with us
+        exported_at = time.monotonic()
+        taken = st.req.taken_at if st.req.taken_at is not None \
+            else st.req.enqueued
         h = KVHandoff(
             prompt=st.prompt, max_new_tokens=st.max_new,
             first_token=int(first), kv_dtype=self.kv_dtype,
@@ -148,7 +156,11 @@ class PrefillWorker(ContinuousGPTEngine):
             v_scale=out[3] if len(out) == 4 else None,
             request_id=st.req.request_id, deadline=st.req.deadline,
             enqueued=st.req.enqueued, trace_ctx=st.req.trace_ctx,
-            src_host=self.host_id)
+            src_host=self.host_id,
+            exported_at=exported_at,
+            queue_wait_s=max(0.0, taken - st.req.enqueued),
+            prefill_s=max(0.0, exported_at - taken),
+            incident_id=flight_mod.current_incident_id())
         self._handoffs += 1
         _M_HANDOFFS.inc(stage="export")
         _M_HANDOFF_BYTES.inc(h.wire_bytes)
@@ -245,6 +257,12 @@ class DecodeWorker(ContinuousGPTEngine):
         if timeout_s is not None:
             cap = time.monotonic() + timeout_s
             deadline = cap if deadline is None else min(deadline, cap)
+        if h.arrived_at is None:
+            # in-process crossing (no from_wire): arrival is now
+            h.arrived_at = time.monotonic()
+        # postmortem correlation (ISSUE 17): if the prefill tier was
+        # mid-incident at export, this tier's next dump joins it
+        flight_mod.adopt_incident(h.incident_id)
         rid = int(h.request_id) or tracing.next_request_id()
         fut: Future = Future()
         fut.request_id = rid
@@ -318,6 +336,10 @@ class DecodeWorker(ContinuousGPTEngine):
             self._prefix.release(shared)
             self._defer_pool = self._pool
             return False
+        # commit point: blocks are allocated, the install WILL run.
+        # Everything before this stamp is decode-queue time; everything
+        # after (install + decode loop) is decode-compute time.
+        t_adm = time.monotonic()
         self._prefix.record_lookup(m.hit_tokens, plen - m.hit_tokens)
         if m.hit_tokens:
             flight_mod.record_event(
@@ -349,6 +371,33 @@ class DecodeWorker(ContinuousGPTEngine):
                        blocks=shared + owned, prompt=prompt)
         self._inflight[slot] = fl
         self._pool.reset_deferral_streak()
+        # latency attribution (ISSUE 17): this is the single place all
+        # five request phases publish from — the prefill tier shipped
+        # its two as measured durations; wire/queue/compute are local
+        # stamps on THIS clock. fl carries the admit stamp so
+        # _complete() can close the (compute, decode) phase.
+        arrived = h.arrived_at if h.arrived_at is not None else t_adm
+        observe_phase("queue", "prefill", h.queue_wait_s)
+        observe_phase("compute", "prefill", h.prefill_s)
+        if h.exported_at is not None:
+            wire_s = max(0.0, arrived - h.exported_at)
+            observe_phase("wire", "handoff", wire_s)
+            # the wire crossing as a span: recorded retroactively on
+            # the DECODE host (re-anchored export stamp → install end),
+            # parented into the request's one fleet-wide trace
+            tracing.record_span(
+                "handoff.wire", h.exported_at, time.monotonic(),
+                parent=req.trace_ctx, request_id=req.request_id,
+                src_host=h.src_host, dst_host=self.host_id,
+                bytes=h.wire_bytes, wire_s=wire_s,
+                decode_queue_s=max(0.0, t_adm - arrived),
+                # the prefill tier's measured durations ride along so
+                # fleet stitching reads ALL five phases off this one
+                # span (stitch_phase_breakdown)
+                queue_wait_s=float(h.queue_wait_s),
+                prefill_s=float(h.prefill_s))
+        observe_phase("queue", "decode", max(0.0, t_adm - arrived))
+        fl._phase_admit_start = t_adm
         flight_mod.record_event(
             "disagg.handoff_installed", request_id=req.request_id,
             host=self.host_id, blocks=nbp, shared_blocks=n_shared,
@@ -356,6 +405,16 @@ class DecodeWorker(ContinuousGPTEngine):
         if self._is_done(fl):  # max_new_tokens=1, or instant eos
             self._complete(slot)
         return True
+
+    def _complete(self, slot: int) -> None:
+        # close the (compute, decode) phase for adopted handoffs: the
+        # admit stamp rides the _InFlight (dies with it — failure-safe)
+        fl = self._inflight.get(slot)
+        t_adm = getattr(fl, "_phase_admit_start", None)
+        if t_adm is not None:
+            observe_phase("compute", "decode",
+                          time.monotonic() - t_adm)
+        super()._complete(slot)
 
     def _wire_to_compute(self, h: KVHandoff):
         """Wire storage → install-ready fp32 block data, padded to the

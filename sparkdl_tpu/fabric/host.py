@@ -94,6 +94,16 @@ class HostHandle:
     def prefix_digest(self, max_entries: int = 1024) -> "dict | None":
         raise NotImplementedError
 
+    def trace(self, request_id: int) -> "dict[str, Any]":
+        """This host's span fragments for one trace (ISSUE 17):
+        ``{"host_id", "now_us", "spans"}``. ``now_us`` is the host's
+        trace clock (µs since its process epoch) read while serving the
+        call — the fleet scraper pairs it with the RPC round-trip
+        midpoint to estimate this host's clock offset, so fragments
+        from hosts with unrelated monotonic epochs stitch into one
+        skew-corrected timeline."""
+        raise NotImplementedError
+
     def drain(self) -> "list[Request]":
         """Stop admission; return the unstarted requests (in-process
         handles return live :class:`Request` objects for queue-level
@@ -176,6 +186,15 @@ class InProcessHost(HostHandle):
     def prefix_digest(self, max_entries: int = 1024) -> "dict | None":
         fn = getattr(self.engine, "prefix_digest", None)
         return fn(max_entries) if callable(fn) else None
+
+    def trace(self, request_id: int) -> "dict[str, Any]":
+        from sparkdl_tpu.observability import tracing
+        fn = getattr(self.engine, "trace", None)
+        spans = (fn(int(request_id)) if callable(fn)
+                 else tracing.spans_for_trace(int(request_id)))
+        return {"host_id": self.host_id,
+                "now_us": tracing.trace_clock_us(),
+                "spans": spans}
 
     def drain(self) -> "list[Request]":
         fault_point("host.drain")

@@ -25,6 +25,12 @@ Server endpoints (:class:`HostServer`, wrapping one engine):
 * ``GET /fabric/snapshot`` → ``engine.snapshot()`` (host_id + capacity
   included — the router's weighting input).
 * ``GET /fabric/digest`` → ``engine.prefix_digest()`` (null for dense).
+* ``GET /fabric/trace?request_id=N`` → this host's span fragments for
+  one trace plus its trace-clock reading (``now_us``) — the
+  :class:`~sparkdl_tpu.observability.fleet.FleetScraper`'s stitching
+  RPC (ISSUE 17). Submit bodies may carry a serialized ``"trace"``
+  span context; the server attaches it so host-side spans parent into
+  the CALLER's trace instead of starting an orphan.
 * ``GET /fabric/healthz`` → the process ``healthz_report()`` (one
   engine per process in real deployments, so process grain == host
   grain here).
@@ -52,7 +58,7 @@ from typing import Any
 
 import numpy as np
 
-from sparkdl_tpu.observability import flight
+from sparkdl_tpu.observability import flight, tracing
 from sparkdl_tpu.reliability.faults import fault_point
 from sparkdl_tpu.serving.queue import (
     DeadlineExceededError,
@@ -128,6 +134,17 @@ class _FabricHandler(BaseHTTPRequestHandler):
                 n = int(params.get("max_entries", ["1024"])[0])
                 dig = owner.engine.prefix_digest(n)
                 self._reply(200, {"digest": dig})
+            elif path == "/fabric/trace":
+                params = urllib.parse.parse_qs(query)
+                rid = int(params.get("request_id", ["0"])[0])
+                self._reply(200, {
+                    "host_id": owner.engine.host_id,
+                    # trace-clock reading WHILE serving: pairs with the
+                    # caller's RPC round-trip midpoint for clock-offset
+                    # estimation (fleet stitching, ISSUE 17)
+                    "now_us": tracing.trace_clock_us(),
+                    "spans": owner.handle_trace(rid),
+                })
             elif path == "/fabric/healthz":
                 from sparkdl_tpu.observability.flight import healthz_report
 
@@ -214,8 +231,14 @@ class HostServer:
                 KVHandoff.from_wire(body["handoff"]), timeout_s=timeout)
         else:
             prompt = np.asarray(body["prompt"], np.int32)
-            fut = self.engine.submit(
-                prompt, int(body["max_new_tokens"]), timeout_s=timeout)
+            # a shipped span context (ISSUE 17) parents this host's
+            # request trace into the CALLER's — the submit span the
+            # queue records links back across the process boundary
+            with tracing.attach(
+                    tracing.context_from_wire(body.get("trace"))):
+                fut = self.engine.submit(
+                    prompt, int(body["max_new_tokens"]),
+                    timeout_s=timeout)
         try:
             result = fut.result(timeout=self.result_timeout_s)
         except FuturesTimeoutError:
@@ -234,6 +257,14 @@ class HostServer:
             "tokens": [int(t) for t in np.asarray(result).ravel()],
             "request_id": rid,
         }
+
+    def handle_trace(self, request_id: int) -> "list[dict]":
+        """This host's finished spans for one trace (the stitching RPC's
+        payload half; the handler adds the clock reading)."""
+        fn = getattr(self.engine, "trace", None)
+        if callable(fn):
+            return fn(int(request_id))
+        return tracing.spans_for_trace(int(request_id))
 
     def handle_drain(self) -> dict:
         self.draining = True
@@ -355,6 +386,11 @@ class HttpHostHandle(HostHandle):
                 "max_new_tokens": int(payload["max_new_tokens"]),
                 "timeout_s": timeout_s,
             }
+            # capture the ambient span HERE (the caller's thread) — the
+            # pool thread that sends the POST has no contextvar state
+            trace = tracing.context_to_wire(tracing.current_context())
+            if trace is not None:
+                body["trace"] = trace
 
         def call():
             out = self._request(
@@ -395,6 +431,11 @@ class HttpHostHandle(HostHandle):
         return self._get(
             f"/fabric/digest?max_entries={int(max_entries)}"
         ).get("digest")
+
+    def trace(self, request_id: int) -> "dict[str, Any]":
+        out = self._get(f"/fabric/trace?request_id={int(request_id)}")
+        out.setdefault("host_id", self.host_id)
+        return out
 
     def drain(self) -> list:
         fault_point("host.drain")

@@ -906,6 +906,14 @@ class Router:
         with self._lock:
             return [s.handle for s in self._hosts.values()]
 
+    def fleet_hosts(self) -> "dict[str, HostHandle]":
+        """``{host_id: handle}`` for the whole fleet (ISSUE 17) — what
+        :meth:`~sparkdl_tpu.observability.fleet.FleetScraper.from_router`
+        registers so the observability plane polls the same handles the
+        router routes over."""
+        with self._lock:
+            return {hid: s.handle for hid, s in self._hosts.items()}
+
     def snapshot(self) -> "dict[str, Any]":
         """Operator/postmortem view. Exposes ``replica_count`` /
         ``healthy_count`` in the pool shape ``healthz_report()``

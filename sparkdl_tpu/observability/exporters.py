@@ -5,8 +5,9 @@ Three ways out of :func:`sparkdl_tpu.observability.registry.registry`:
 * :class:`MetricsServer` — stdlib ``http.server`` serving the Prometheus
   text exposition on ``/metrics`` (and the JSON snapshot on
   ``/metrics.json``, SLO burn on ``/slo.json``, the reliability health
-  aggregate on ``/healthz``, and a live flight-recorder bundle on
-  ``/debug/flight`` — ISSUE 9); opt-in per process via
+  aggregate on ``/healthz``, a live flight-recorder bundle on
+  ``/debug/flight`` — ISSUE 9 — and one request's finished spans on
+  ``/debug/trace/<request_id>`` — ISSUE 17); opt-in per process via
   ``SPARKDL_TPU_METRICS_PORT`` (:func:`maybe_start_metrics_server`), so
   a serving host or TPU worker becomes scrape-able with zero
   dependencies;
@@ -79,6 +80,25 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.dumps(
                     flight.flight_recorder().debug_view(),
                     default=repr).encode()
+                ctype = "application/json"
+            elif path.startswith("/debug/trace/"):
+                # ISSUE 17: one request's finished spans from THIS
+                # process's ring, keyed by request id (= trace id) —
+                # the single-host half of fleet_trace()
+                from sparkdl_tpu.observability import tracing
+
+                try:
+                    rid = int(path.rsplit("/", 1)[1])
+                except ValueError:
+                    self.send_error(
+                        400, "request id must be an integer")
+                    return
+                body = json.dumps({
+                    "request_id": rid,
+                    "host_hash": tracing.host_hash(),
+                    "now_us": tracing.trace_clock_us(),
+                    "spans": tracing.spans_for_trace(rid),
+                }, default=repr).encode()
                 ctype = "application/json"
             else:
                 self.send_error(404)

@@ -59,6 +59,8 @@ __all__ = [
     "ENV_DIR",
     "FlightRecorder",
     "add_context_provider",
+    "adopt_incident",
+    "current_incident_id",
     "flight_recorder",
     "healthz_report",
     "record_event",
@@ -145,6 +147,14 @@ class FlightRecorder:
         self._trigger_lock = threading.Lock()
         self._last_dump_mono: float = -float("inf")
         self._pending: "threading.Timer | None" = None
+        #: cross-host postmortem correlation (ISSUE 17): one incident id
+        #: spans every bundle this process writes within ``incident_ttl_s``
+        #: of the first trigger, and rides the KV-handoff wire so the
+        #: PEER tier's bundles carry the SAME id — /debug/flight output
+        #: from both hosts joins on it.
+        self.incident_ttl_s = 60.0
+        self._incident_id: "str | None" = None
+        self._incident_at: float = -float("inf")
 
     # -- the hot path --------------------------------------------------------
     def record(self, kind: str, **fields: Any) -> None:
@@ -192,6 +202,48 @@ class FlightRecorder:
         """Snapshot of the span-completion ring (oldest first)."""
         evs = safe_ring_snapshot(self._span_ring)
         return evs[-last:] if last else evs
+
+    # -- incident correlation ------------------------------------------------
+    def current_incident_id(self) -> "str | None":
+        """The live incident id, or None once ``incident_ttl_s`` has
+        passed since the last trigger/adoption — reliability events
+        separated by a quiet minute are different incidents."""
+        with self._trigger_lock:
+            if time.monotonic() - self._incident_at > self.incident_ttl_s:
+                return None
+            return self._incident_id
+
+    def adopt_incident(self, incident_id: "str | None") -> None:
+        """Join an incident another host started: a KV handoff (or any
+        cross-host payload) carrying an incident id stamps it here, so
+        THIS host's next bundle shares the id and the two tiers'
+        ``/debug/flight`` output is joinable. A live local incident is
+        never overwritten — first writer wins, both sides converge on
+        the oldest id in the causal chain."""
+        if not incident_id:
+            return
+        with self._trigger_lock:
+            now = time.monotonic()
+            if self._incident_id is None \
+                    or now - self._incident_at > self.incident_ttl_s:
+                self._incident_id = str(incident_id)
+            self._incident_at = now
+
+    def reset_incident(self) -> None:
+        """Close the live incident window (test isolation, or an
+        operator declaring the incident over): the next trigger mints a
+        FRESH id instead of extending this one's TTL."""
+        with self._trigger_lock:
+            self._incident_id = None
+            self._incident_at = -float("inf")
+
+    def _ensure_incident_locked(self) -> str:
+        now = time.monotonic()
+        if self._incident_id is None \
+                or now - self._incident_at > self.incident_ttl_s:
+            self._incident_id = f"inc-{os.getpid():x}-{next(self._seq)}"
+        self._incident_at = now
+        return self._incident_id
 
     # -- configuration -------------------------------------------------------
     def configure(self, *, directory: Any = _UNSET,
@@ -242,6 +294,7 @@ class FlightRecorder:
         all_traces = tracing.trace_events()
         bundle = {
             "reason": reason,
+            "incident_id": self.current_incident_id(),
             "time_unix": time.time(),
             "pid": os.getpid(),
             "events_total": self.events_total,
@@ -263,7 +316,14 @@ class FlightRecorder:
                          extra: "dict | None" = None) -> "str | None":
         """Build a bundle, keep it as :attr:`last_bundle`, and write it
         to :attr:`directory` (pruned to ``max_bundles``) when one is
-        configured. Returns the file path (None with no directory)."""
+        configured. Returns the file path (None with no directory).
+
+        A triggered postmortem IS an incident: one is minted here if
+        none is live, so every bundle carries an ``incident_id`` and
+        bundles from correlated failures (this host's, and — via the
+        handoff wire's adoption — the peer tier's) share it."""
+        with self._trigger_lock:
+            self._ensure_incident_locked()
         bundle = self.dump(reason, extra=extra)
         self.last_bundle = bundle
         _dumps_counter().inc(reason=reason)
@@ -321,7 +381,13 @@ class FlightRecorder:
         covers this" is never true for a dump whose process is about to
         die. A recorder merely *configured* with ``settle_s=0`` (tests)
         keeps normal rate-limiting."""
-        self.record("trigger", reason=reason, **fields)
+        # the incident starts at the TRIGGER, not at the settled dump:
+        # payloads crossing hosts inside the settle window must already
+        # carry the id for the peer's bundle to join on
+        with self._trigger_lock:
+            incident = self._ensure_incident_locked()
+        self.record("trigger", reason=reason, incident_id=incident,
+                    **fields)
         force_inline = settle_s is not None and settle_s <= 0
         if settle_s is None:
             settle_s = self.settle_s
@@ -394,6 +460,17 @@ def trigger_dump(reason: str, *, settle_s: "float | None" = None,
     """Fire a reliability trigger on the process recorder
     (``settle_s=0`` dumps inline — see the method)."""
     _RECORDER.trigger_dump(reason, settle_s=settle_s, **fields)
+
+
+def current_incident_id() -> "str | None":
+    """The process recorder's live incident id (None outside one) —
+    what the KV-handoff export stamps onto the wire (ISSUE 17)."""
+    return _RECORDER.current_incident_id()
+
+
+def adopt_incident(incident_id: "str | None") -> None:
+    """Join an incident that rode in over the wire (see the method)."""
+    _RECORDER.adopt_incident(incident_id)
 
 
 # -- context providers --------------------------------------------------------

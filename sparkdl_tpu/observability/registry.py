@@ -325,6 +325,30 @@ class MetricFamily:
                 lo = b
         return good, total
 
+    def hist_series(self) -> "list[tuple[dict, dict]]":
+        """Histogram-only structured readout: one ``(labels, stats)``
+        pair per label series, where ``labels`` maps label name → value
+        and ``stats`` is ``{count, sum, mean, p50, p95, p99}`` — the
+        accessor programmatic consumers (per-tier SLO attribution, the
+        bench's phase breakdown) use instead of parsing rendered
+        ``snapshot_values`` label strings (a format coupling)."""
+        if self.kind != "histogram":
+            raise ValueError(
+                f"{self.name} is a {self.kind}; hist_series() is "
+                "histogram-only"
+            )
+        out = []
+        for key, v in self._copy_series():
+            out.append((dict(zip(self.label_names, key)), {
+                "count": v.n,
+                "sum": v.sum,
+                "mean": (v.sum / v.n) if v.n else None,
+                "p50": self._hist_percentile(v, 50),
+                "p95": self._hist_percentile(v, 95),
+                "p99": self._hist_percentile(v, 99),
+            }))
+        return out
+
     def labelled_values(self, label: str) -> dict:
         """Scalar series keyed by ONE label dimension's value —
         the structured accessor for programmatic consumers (parsing the

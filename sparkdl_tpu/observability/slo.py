@@ -58,6 +58,12 @@ REQUESTS_METRIC = "sparkdl_serving_requests_total"
 #: overloaded engine shedding 90% of submits would report availability
 #: compliance 1.0 during exactly the incident the SLO exists to catch.
 REJECTED_METRIC = "sparkdl_queue_rejected_total"
+#: Per-request phase attribution (ISSUE 17): the disagg path's
+#: ``{phase, tier}`` histogram. When it carries traffic the tracker
+#: folds a windowed per-tier breakdown into every report, so a latency
+#: burn names the GUILTY tier ("burn 4.2, 71% of request time was
+#: (queue, decode)") instead of just ringing the bell.
+PHASE_METRIC = "sparkdl_request_phase_seconds"
 
 def _gauges(reg: MetricsRegistry):
     # get-or-create per sample: declaration is idempotent and samples
@@ -118,8 +124,10 @@ class SLO:
 
 
 class _Totals(collections.namedtuple(
-        "_Totals", "t lat_good lat_total ok failed rejected")):
-    """One cumulative sample of the source series."""
+        "_Totals", "t lat_good lat_total ok failed rejected phases")):
+    """One cumulative sample of the source series. ``phases`` maps
+    ``(phase, tier) -> (count, seconds)`` cumulative pairs from
+    :data:`PHASE_METRIC` (empty when the disagg path is idle)."""
 
 
 class SLOTracker:
@@ -156,8 +164,15 @@ class SLOTracker:
         if fam is not None:
             values = fam.snapshot_values()
             rejected = float(values.get("", 0.0))
+        phases: "dict[tuple, tuple]" = {}
+        fam = self._reg.get(PHASE_METRIC)
+        if fam is not None:
+            for labels, stats in fam.hist_series():
+                phases[(labels.get("phase", ""),
+                        labels.get("tier", ""))] = (
+                    stats["count"], stats["sum"])
         return _Totals(self._clock(), lat_good, lat_total, ok, failed,
-                       rejected)
+                       rejected, phases)
 
     @staticmethod
     def _dimension(good: float, total: float, target: float) -> dict:
@@ -214,7 +229,32 @@ class SLOTracker:
             report["availability"] = dim
             self._publish(objective, compliance_g, burn_g,
                           "availability", dim)
+        phases = self._phase_attribution(cur, base, d)
+        if phases:
+            report["phases"] = phases
+            # the guilty tier: where the window's request time went
+            report["dominant_phase"] = {
+                k: phases[0][k] for k in ("phase", "tier", "share")}
         return report
+
+    @staticmethod
+    def _phase_attribution(cur: _Totals, base: _Totals, d) -> "list[dict]":
+        """Windowed per-(phase, tier) time attribution (ISSUE 17),
+        largest share first — so a burning latency SLO reads which
+        tier's which phase ate the window's request time."""
+        rows = []
+        for key, (cnt, tot) in (cur.phases or {}).items():
+            b_cnt, b_tot = (base.phases or {}).get(key, (0, 0.0))
+            secs = d(tot, b_tot)
+            if secs > 0:
+                rows.append({"phase": key[0], "tier": key[1],
+                             "seconds": secs,
+                             "observations": int(d(cnt, b_cnt))})
+        total = sum(r["seconds"] for r in rows)
+        for r in rows:
+            r["share"] = r["seconds"] / total if total else 0.0
+        rows.sort(key=lambda r: r["seconds"], reverse=True)
+        return rows
 
     def _publish(self, objective, compliance, burn, dimension: str,
                  dim: dict) -> None:

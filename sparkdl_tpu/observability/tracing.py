@@ -20,7 +20,7 @@ with :func:`attach` — the pattern ``serving/queue.py`` uses so a request's
 queue-wait and device-step spans hang off the submitter's trace.
 
 Per-request traces (ISSUE 9): every serving request is allocated a
-process-unique id at ``RequestQueue.submit`` (:func:`next_request_id` —
+FLEET-unique id at ``RequestQueue.submit`` (:func:`next_request_id` —
 an int, the ONLY per-request cost with tracing off) that doubles as its
 trace id. :func:`request_context` roots the request's trace; stage spans
 (queue wait, prefill, the terminal ``serving.request``) parent on it,
@@ -29,6 +29,17 @@ in their OWN trace carrying a ``links=[request ids...]`` attribute that
 fans them into every rider's trace. :func:`spans_for_trace` resolves one
 request id to its full span set (direct spans + linked batch traces);
 ``ServingEngine.trace(request_id)`` is the operator surface over it.
+
+Fleet uniqueness (ISSUE 17): ids are host-qualified — the high bits are
+a stable per-process host hash (:func:`host_hash`, derived from the
+fabric ``host_id``: ``SPARKDL_TPU_HOST_ID`` or ``hostname:pid``), the
+low 32 bits a local counter — so two hosts can NEVER mint colliding
+trace ids and a :class:`SpanContext` can cross processes
+(:func:`context_to_wire` / :func:`context_from_wire`, shipped in the
+fabric submit payload and ``KVHandoff.to_wire``). The receiving host
+``attach()``\\ es the deserialized context so prefill-tier, handoff, and
+decode-tier spans parent into ONE stitched trace
+(``observability/fleet.py`` is the cross-host aggregation surface).
 """
 
 from __future__ import annotations
@@ -47,17 +58,23 @@ __all__ = [
     "SpanContext",
     "attach",
     "clear_trace",
+    "context_from_wire",
+    "context_to_wire",
     "current_context",
     "disable_tracing",
     "enable_tracing",
     "export_chrome_trace",
+    "host_hash",
+    "host_of_id",
     "new_trace_context",
     "next_request_id",
     "observe_stage",
     "record_span",
     "request_context",
+    "set_trace_host",
     "span",
     "spans_for_trace",
+    "trace_clock_us",
     "trace_events",
     "tracing_enabled",
 ]
@@ -101,6 +118,66 @@ _EPOCH = time.monotonic()
 
 _now = time.monotonic
 
+#: bits reserved for the per-host local counter in every minted id
+HOST_ID_SHIFT = 32
+
+
+def _stable_host_hash(host_id: str) -> int:
+    """Deterministic 31-bit hash of a host identity string (NOT
+    ``hash()``, which is salted per process — the same ``host_id`` must
+    map to the same id prefix across restarts so traces and logs remain
+    joinable)."""
+    import zlib
+
+    return (zlib.crc32(host_id.encode()) & 0x7FFFFFFF) or 1
+
+
+def _default_host_identity() -> str:
+    env = os.environ.get("SPARKDL_TPU_HOST_ID")
+    if env:
+        return env
+    import socket
+
+    return f"{socket.gethostname()}:{os.getpid()}"
+
+
+_host_hash: int = _stable_host_hash(_default_host_identity())
+#: precomputed high-bits base so the minting hot path is one OR
+_id_base: int = _host_hash << HOST_ID_SHIFT
+
+
+def host_hash() -> int:
+    """This process's 31-bit stable host hash — the high bits of every
+    id :func:`next_request_id` mints (fleet uniqueness, ISSUE 17)."""
+    return _host_hash
+
+
+def host_of_id(any_id: int) -> int:
+    """The host hash folded into a request/span id (0 for pre-17 ids)."""
+    return int(any_id) >> HOST_ID_SHIFT
+
+
+def set_trace_host(host_id: str) -> int:
+    """Re-key this process's id space to ``host_id`` (returns the new
+    :func:`host_hash`). Operators pin identity via ``SPARKDL_TPU_HOST_ID``
+    before import; this is the in-code override (tests simulating a
+    foreign host, a fabric process adopting its assigned id late).
+    Already-minted ids keep their old prefix — ids only ever need to be
+    unique, not re-derivable."""
+    global _host_hash, _id_base
+    _host_hash = _stable_host_hash(host_id)
+    _id_base = _host_hash << HOST_ID_SHIFT
+    return _host_hash
+
+
+def trace_clock_us() -> float:
+    """This process's trace clock: µs since its span-timestamp epoch —
+    the same timebase ``ts`` in :func:`trace_events` uses. A fleet
+    scraper reads it over the trace RPC and estimates per-host clock
+    offset from the RPC round-trip midpoint (``fleet.FleetScraper``);
+    monotonic clocks never cross processes raw."""
+    return (time.monotonic() - _EPOCH) * 1e6
+
 
 @dataclass(frozen=True)
 class SpanContext:
@@ -138,15 +215,19 @@ def current_context() -> "SpanContext | None":
 
 def _next_id() -> int:
     with _ids_lock:
-        return next(_ids)
+        return _id_base | next(_ids)
 
 
 def next_request_id() -> int:
-    """Process-unique id for one serving request; doubles as its trace
-    id. Allocated unconditionally at submit — with tracing disabled this
-    int is the ONLY per-request tracing cost (guarded by run-tests.sh)."""
+    """Fleet-unique id for one serving request; doubles as its trace
+    id. High bits are this host's stable hash (:func:`host_hash`), low
+    bits a local counter — two hosts cannot collide, so a
+    ``DecodeWorker`` adopting a foreign id (ISSUE 16/17) can never be
+    handed an id this process will later mint. Allocated unconditionally
+    at submit — with tracing disabled this int is the ONLY per-request
+    tracing cost (guarded by run-tests.sh)."""
     with _ids_lock:
-        return next(_ids)
+        return _id_base | next(_ids)
 
 
 def request_context(request_id: int) -> "SpanContext | None":
@@ -188,6 +269,28 @@ def attach(ctx: "SpanContext | None") -> _Attach:
     """Context manager making ``ctx`` the ambient parent in this thread —
     the receiving half of cross-thread propagation."""
     return _Attach(ctx)
+
+
+def context_to_wire(ctx: "SpanContext | None") -> "dict | None":
+    """Serialize a :class:`SpanContext` for a cross-process hop (the
+    fabric submit body, ``KVHandoff.to_wire``). None stays None — a
+    tracing-off sender ships nothing."""
+    if ctx is None:
+        return None
+    return {"trace_id": int(ctx.trace_id), "span_id": int(ctx.span_id)}
+
+
+def context_from_wire(d: "dict | None") -> "SpanContext | None":
+    """Rebuild a shipped :class:`SpanContext` on the receiving host.
+    None with tracing off (the receiver pays zero, matching
+    :func:`request_context`'s convention) or for an absent/garbled
+    payload — propagation is best-effort, never a request failure."""
+    if not _enabled or not isinstance(d, dict):
+        return None
+    try:
+        return SpanContext(int(d["trace_id"]), int(d["span_id"]))
+    except (KeyError, TypeError, ValueError):
+        return None
 
 
 class _NoopSpan:

@@ -122,6 +122,12 @@ class Request:
     #: skips the set_running handshake (a Future runs only once) and
     #: the caller can no longer cancel it — it was already admitted.
     started: bool = False
+    #: monotonic stamp of the FIRST take (set alongside ``started``):
+    #: the queue-wait/compute phase boundary the per-request latency
+    #: attribution differences against (ISSUE 17) — a deferred retake
+    #: keeps the original stamp, matching the wait histogram's
+    #: first-take-only policy.
+    taken_at: "float | None" = None
 
     def expired(self, now: float | None = None) -> bool:
         return (self.deadline is not None
@@ -274,6 +280,7 @@ class RequestQueue:
                         _M_CANCELLED.inc()
                         continue
                     req.started = True
+                    req.taken_at = now
                     fresh.append(req)
                 out.append(req)
             self._update_depth_locked()

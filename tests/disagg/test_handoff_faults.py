@@ -252,7 +252,7 @@ def test_soak_prefill_host_kill_and_install_faults_lose_nothing(bundle):
                     # its unstarted work on the survivor
                     pr.prefill.remove_host("p0", drain=True)
             for f, m in futs:
-                out = np.asarray(f.result(timeout=60))
+                out = np.asarray(f.result(timeout=300))
                 assert len(out) == m
         snap = pr.snapshot()["disagg"]
         assert snap["submitted"] == 24

@@ -1,6 +1,8 @@
 """Concurrent exporter scrapes: /metrics + /metrics.json + /slo.json +
-/healthz hammered from threads while serving-style mutation runs — no
-torn output, no exceptions, every response parseable (ISSUE 9)."""
+/healthz + /debug/flight + /debug/trace/<rid> hammered from threads
+while serving-style mutation runs — with a FleetScraper polling the
+same process concurrently (ISSUE 17) — no torn output, no exceptions,
+every response parseable (ISSUE 9)."""
 
 import json
 import threading
@@ -9,8 +11,9 @@ import urllib.request
 
 import pytest
 
-from sparkdl_tpu.observability import flight, slo
+from sparkdl_tpu.observability import flight, slo, tracing
 from sparkdl_tpu.observability.exporters import MetricsServer
+from sparkdl_tpu.observability.fleet import FleetScraper
 from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.observability.slo import SLO, SLOTracker
 
@@ -46,6 +49,32 @@ def test_concurrent_scrapes_against_mutation(server):
     provider = flight.add_context_provider(
         "scrape-torture", lambda: {"replica_count": 2, "healthy_count": 2,
                                    "inflight_request_ids": [1, 2]})
+    tracing.clear_trace()
+    tracing.enable_tracing()
+    torture_rid = tracing.next_request_id()
+    # a fleet scraper polling THIS process as a duck-typed host, racing
+    # the HTTP scrapes and the mutators (ISSUE 17)
+    fleet = FleetScraper(probes=1)
+
+    class _SelfHost:
+        host_id = "self"
+
+        def trace(self, rid):
+            return {"host_id": "self",
+                    "now_us": tracing.trace_clock_us(),
+                    "spans": tracing.spans_for_trace(int(rid))}
+
+        def capacity(self):
+            return {"host_id": "self"}
+
+        def health(self):
+            return {"status": "ok", "host_id": "self"}
+
+        def snapshot(self):
+            return {"host_id": "self"}
+
+    fleet.add_host(_SelfHost())
+    fleet_polls = [0]
 
     def mutate(seed):
         i = 0
@@ -54,12 +83,27 @@ def test_concurrent_scrapes_against_mutation(server):
                 counter.inc(k=str((seed + i) % 5))
                 hist.observe(0.001 * (i % 7))
                 flight.record_event("torture", i=i)
+                with tracing.span(
+                        "torture.step",
+                        parent=tracing.request_context(torture_rid),
+                        request_id=torture_rid):
+                    pass
                 if i % 50 == 0:
                     # trackers churn while /slo.json lists them
                     t = slo.register(SLOTracker(SLO(
                         name=f"churn-{seed}", latency_threshold_s=0.1)))
                     slo.unregister(t)
                 i += 1
+        except BaseException as e:  # pragma: no cover - failure capture
+            errors.append(e)
+
+    def poll_fleet():
+        try:
+            while not stop.is_set():
+                out = fleet.fleet_trace(torture_rid)
+                assert out["request_id"] == torture_rid
+                assert fleet.fleet_healthz()["status"] == "ok"
+                fleet_polls[0] += 1
         except BaseException as e:  # pragma: no cover - failure capture
             errors.append(e)
 
@@ -71,6 +115,11 @@ def test_concurrent_scrapes_against_mutation(server):
         and isinstance(json.loads(b)["slos"], list),
         "/healthz": lambda s, b: s in (200, 503)
         and json.loads(b)["status"] in ("ok", "degraded", "unhealthy"),
+        "/debug/flight": lambda s, b: s == 200
+        and isinstance(json.loads(b)["bundle"]["events"], list),
+        f"/debug/trace/{torture_rid}": lambda s, b: s == 200
+        and json.loads(b)["request_id"] == torture_rid
+        and isinstance(json.loads(b)["spans"], list),
     }
     scrape_counts = {path: 0 for path in checks}
 
@@ -87,18 +136,32 @@ def test_concurrent_scrapes_against_mutation(server):
                for s in range(2)]
     threads += [threading.Thread(target=scrape, args=(p,), daemon=True)
                 for p in checks for _ in range(2)]
+    threads += [threading.Thread(target=poll_fleet, daemon=True)
+                for _ in range(2)]
+    def _saturated():
+        return (all(n >= 3 for n in scrape_counts.values())
+                and fleet_polls[0] >= 3)
+
     try:
         for t in threads:
             t.start()
-        time.sleep(1.5)
+        # run until every endpoint has served >=3 clean scrapes (a fixed
+        # window flakes when earlier tests leave a large flight ring and
+        # /debug/flight responses get slow), with a hard cap
+        deadline = time.monotonic() + 30.0
+        while not _saturated() and time.monotonic() < deadline:
+            time.sleep(0.05)
     finally:
         stop.set()
         for t in threads:
             t.join(timeout=10)
         slo.unregister(tracker)
         flight.remove_context_provider(provider)
+        tracing.disable_tracing()
+        tracing.clear_trace()
     assert not errors, errors
     assert all(n >= 3 for n in scrape_counts.values()), scrape_counts
+    assert fleet_polls[0] >= 3, fleet_polls
 
 
 def test_slo_json_lists_registered_tracker(server):
@@ -126,6 +189,29 @@ def test_healthz_degrades_with_quarantined_pool(server):
         flight.remove_context_provider(name)
     assert status == 503
     assert json.loads(body)["status"] == "unhealthy"
+
+
+def test_debug_trace_serves_request_spans(server):
+    tracing.clear_trace()
+    tracing.enable_tracing()
+    try:
+        rid = tracing.next_request_id()
+        with tracing.span("exporter.debug.span",
+                          parent=tracing.request_context(rid),
+                          request_id=rid):
+            pass
+        status, body = _get(server.port, f"/debug/trace/{rid}")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["request_id"] == rid
+        assert doc["host_hash"] == tracing.host_hash()
+        assert doc["now_us"] > 0
+        assert any(e["name"] == "exporter.debug.span" for e in doc["spans"])
+        status, _ = _get(server.port, "/debug/trace/not-a-number")
+        assert status == 400
+    finally:
+        tracing.disable_tracing()
+        tracing.clear_trace()
 
 
 def test_debug_flight_serves_live_bundle(server):

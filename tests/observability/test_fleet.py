@@ -1,0 +1,221 @@
+"""Fleet observability plane (ISSUE 17): clock-offset estimation,
+skew-corrected cross-host trace stitching, phase attribution, and the
+fleet aggregation endpoints — all against duck-typed fake hosts with
+RIGGED clocks, so the skew arithmetic is checked against known truth."""
+
+import json
+import urllib.request
+
+import pytest
+
+from sparkdl_tpu.observability import tracing
+from sparkdl_tpu.observability.fleet import (
+    PHASES,
+    FleetScraper,
+    FleetServer,
+    stitch_phase_breakdown,
+)
+
+#: true-timeline layout of one split request (seconds): submit 0.0,
+#: take 0.1, export 0.65, arrive 0.9, admit 1.0, done 1.4 — five phases
+#: 0.1 / 0.55 / 0.25 / 0.1 / 0.4 telescoping to a 1.4 s e2e.
+E2E_S = 1.4
+
+
+def _span(name, ts_s, dur_s, host_offset_us, trace_id, span_id, **attrs):
+    """One finished-span event as a host with ``host_offset_us`` clock
+    skew would report it (its ``ts`` runs AHEAD by the offset)."""
+    args = {"trace_id": trace_id, "span_id": span_id}
+    args.update(attrs)
+    return {"name": name, "ph": "X",
+            "ts": ts_s * 1e6 + host_offset_us, "dur": dur_s * 1e6,
+            "pid": 1, "tid": 1, "args": args}
+
+
+class _SkewHost:
+    """Duck-typed HostHandle: fixed clock offset, canned spans."""
+
+    def __init__(self, host_id, offset_us, spans=(), *, status="ok"):
+        self.host_id = host_id
+        self.offset_us = offset_us
+        self.spans = list(spans)
+        self.status = status
+        self.trace_calls = 0
+
+    def trace(self, request_id):
+        self.trace_calls += 1
+        rid = int(request_id)
+        return {
+            "host_id": self.host_id,
+            "now_us": tracing.trace_clock_us() + self.offset_us,
+            "spans": [s for s in self.spans
+                      if s["args"].get("trace_id") == rid],
+        }
+
+    def capacity(self):
+        return {"host_id": self.host_id, "free_slots": 1}
+
+    def health(self):
+        return {"status": self.status, "host_id": self.host_id}
+
+    def snapshot(self):
+        return {"host_id": self.host_id, "slo": {"name": self.host_id}}
+
+
+def _split_request_fleet(rid):
+    """Two fake hosts holding the canned split request: prefill host
+    'pA' runs 5 s AHEAD of the scraper clock, decode host 'dB' 3 s
+    BEHIND — uncorrected, dB's spans would sort before pA's."""
+    pre = _SkewHost("pA", +5_000_000.0, [
+        _span("serving.queue_wait", 0.0, 0.1, +5_000_000.0, rid, rid + 1,
+              request_id=rid),
+        _span("disagg.handoff_export", 0.6, 0.05, +5_000_000.0,
+              rid, rid + 2, request_id=rid),
+    ])
+    dec = _SkewHost("dB", -3_000_000.0, [
+        _span("handoff.wire", 0.65, 0.45, -3_000_000.0, rid, rid + 3,
+              request_id=rid, wire_s=0.25, decode_queue_s=0.1,
+              queue_wait_s=0.1, prefill_s=0.55),
+        _span("serving.request", 1.0, 0.4, -3_000_000.0, rid, rid + 4,
+              request_id=rid),
+    ])
+    scraper = FleetScraper(probes=2)
+    scraper.add_host(pre, tier="prefill")
+    scraper.add_host(dec, tier="decode")
+    return scraper, pre, dec
+
+
+RID = (7 << 32) | 1  # a host-qualified id minted "elsewhere"
+
+
+def test_clock_offsets_recover_known_skew():
+    scraper, pre, dec = _split_request_fleet(RID)
+    offsets = scraper.clock_offsets()
+    # in-process RPC round trips are microseconds; the rigged offsets
+    # are seconds — recovery to 50 ms is orders of magnitude of margin
+    assert offsets["pA"] == pytest.approx(5_000_000.0, abs=50_000)
+    assert offsets["dB"] == pytest.approx(-3_000_000.0, abs=50_000)
+    # cached: another call fires no new probe RPCs
+    calls = pre.trace_calls
+    scraper.clock_offsets()
+    assert pre.trace_calls == calls
+    scraper.clock_offsets(refresh=True)
+    assert pre.trace_calls > calls
+
+
+def test_fleet_trace_stitches_in_skew_corrected_order():
+    scraper, _, _ = _split_request_fleet(RID)
+    out = scraper.fleet_trace(RID)
+    names = [e["name"] for e in out["spans"]]
+    # uncorrected, dB (-3 s) would lead; corrected, true wall order:
+    assert names == ["serving.queue_wait", "disagg.handoff_export",
+                     "handoff.wire", "serving.request"]
+    hosts = [e["host"] for e in out["spans"]]
+    assert hosts == ["pA", "pA", "dB", "dB"]
+    # corrected timeline spans exactly the true e2e window
+    t0 = out["spans"][0]["ts"]
+    t1 = max(e["ts"] + e["dur"] for e in out["spans"])
+    assert (t1 - t0) / 1e6 == pytest.approx(E2E_S, abs=0.05)
+    assert out["hosts"]["pA"]["tier"] == "prefill"
+    assert out["hosts"]["dB"]["clock_offset_us"] == pytest.approx(
+        -3_000_000.0, abs=50_000)
+
+
+def test_stitched_phases_telescope_to_corrected_e2e():
+    scraper, _, _ = _split_request_fleet(RID)
+    out = scraper.fleet_trace(RID)
+    phases = out["phases"]
+    assert [(p["phase"], p["tier"]) for p in phases] == list(PHASES)
+    by = {(p["phase"], p["tier"]): p["seconds"] for p in phases}
+    assert by[("queue", "prefill")] == pytest.approx(0.1)
+    assert by[("compute", "prefill")] == pytest.approx(0.55)
+    assert by[("wire", "handoff")] == pytest.approx(0.25)
+    assert by[("queue", "decode")] == pytest.approx(0.1)
+    assert by[("compute", "decode")] == pytest.approx(0.4, abs=0.06)
+    assert sum(by.values()) == pytest.approx(E2E_S, abs=0.06)
+
+
+def test_stitch_dedups_spans_shared_by_hosts_in_one_process():
+    scraper, pre, dec = _split_request_fleet(RID)
+    # both hosts report the SAME span (one process, one tracing ring)
+    shared = dict(pre.spans[0])
+    dec.spans.append(shared)
+    out = scraper.fleet_trace(RID)
+    span_ids = [e["args"]["span_id"] for e in out["spans"]]
+    assert len(span_ids) == len(set(span_ids)) == 4
+
+
+def test_fleet_trace_survives_a_dead_host():
+    scraper, pre, _ = _split_request_fleet(RID)
+
+    class _Dead:
+        host_id = "gone"
+
+        def trace(self, rid):
+            raise ConnectionError("unreachable")
+
+    scraper.add_host(_Dead())
+    out = scraper.fleet_trace(RID)
+    assert "error" in out["hosts"]["gone"]
+    assert len(out["spans"]) == 4  # the live fragments still stitch
+
+
+def test_stitch_phase_breakdown_none_without_a_crossing():
+    assert stitch_phase_breakdown(
+        [_span("serving.request", 0.0, 1.0, 0.0, 5, 6)]) is None
+
+
+def test_export_fleet_trace_writes_perfetto_json(tmp_path):
+    scraper, _, _ = _split_request_fleet(RID)
+    path = tmp_path / "fleet.json"
+    n = scraper.export_fleet_trace(path, RID)
+    assert n == 4
+    doc = json.loads(path.read_text())
+    rows = {e["host"]: e["pid"] for e in doc["traceEvents"]}
+    assert rows["pA"] != rows["dB"]  # one perfetto row per host
+
+
+def test_fleet_healthz_is_worst_of():
+    scraper, _, dec = _split_request_fleet(RID)
+    assert scraper.fleet_healthz()["status"] == "ok"
+    dec.status = "degraded"
+    assert scraper.fleet_healthz()["status"] == "degraded"
+    dec.status = "unhealthy"
+    report = scraper.fleet_healthz()
+    assert report["status"] == "unhealthy"
+    assert report["hosts"]["dB"]["status"] == "unhealthy"
+    assert report["hosts"]["pA"]["status"] == "ok"
+
+
+def test_fleet_server_endpoints():
+    scraper, _, dec = _split_request_fleet(RID)
+    with FleetServer(scraper, port=0) as srv:
+        def get(path):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}{path}",
+                        timeout=10) as r:
+                    return r.status, r.read().decode()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read().decode()
+
+        status, body = get("/fleet/metrics")
+        assert status == 200
+        assert "sparkdl_fleet_hosts" in body
+        status, body = get("/fleet/slo.json")
+        assert status == 200
+        doc = json.loads(body)
+        assert set(doc["hosts"]) == {"pA", "dB"}
+        status, body = get("/fleet/healthz")
+        assert status == 200
+        assert json.loads(body)["status"] == "ok"
+        status, body = get(f"/fleet/trace/{RID}")
+        assert status == 200
+        doc = json.loads(body)
+        assert [e["name"] for e in doc["spans"]][0] == "serving.queue_wait"
+        assert doc["phases"] is not None
+        status, _ = get("/fleet/trace/not-a-number")
+        assert status == 400
+        dec.status = "unhealthy"
+        status, _ = get("/fleet/healthz")
+        assert status == 503
