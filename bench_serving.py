@@ -86,6 +86,16 @@ tick), `slo_burn_before_after` (rolling burn at burst end vs after
 recovery, window `BENCH_AUTOSCALE_SLO_WINDOW`=3 s), and the full
 `autoscale` block (`BENCH_AUTOSCALE_REQUESTS`=192 burst requests,
 `BENCH_AUTOSCALE_MAX`=3 replicas).
+
+Tiered KV parking section (ISSUE 18): `BENCH_PARK_DEPTH` (e.g.
+"8,16"; empty disables) sets the idle-session counts to sweep. Each
+depth runs that many turn-1 conversations through an engine whose
+device pool (`BENCH_PARK_KV_BLOCKS`=20) holds ~2 live sessions while
+the host tier (`BENCH_PARK_HOST_BLOCKS`=512) parks the rest, then
+times every turn-2 resume (restore parked blocks + tail prefill) vs
+the same transcript re-prefilled cold by an untiered engine. Emits
+`turn_resume_p50_ms`, `reprefill_p50_ms`, `parked_sessions_per_chip`
+and the `park` block (per-depth tier occupancy, unparks, fallbacks).
 """
 
 import json
@@ -449,6 +459,130 @@ def _gpt_spec_section():
                 round(agree / total, 4) if total else None),
             "tokens_per_s": quant["stats"]["tokens_per_s"],
         }
+    return out
+
+
+def _gpt_park_section():
+    """Tiered KV session parking (ISSUE 18): multi-turn chat where the
+    device pool holds only a handful of live sessions, but the host
+    tier parks every idle conversation's KV blocks. For each depth in
+    ``BENCH_PARK_DEPTH`` (comma-separated session counts; empty
+    disables): run depth turn-1 conversations, park them all, then
+    time each turn-2 resume (parked path restored via one H2D install
+    per block + tail prefill) against the same turn-2 served by an
+    untiered engine that must re-prefill the whole transcript. Emits
+    ``turn_resume_p50_ms`` vs ``reprefill_p50_ms`` per depth,
+    ``parked_sessions_per_chip``, and the tier occupancy — the
+    capacity story is ``parked_sessions / device_live_sessions``
+    (sessions held per chip vs what device HBM alone could keep)."""
+    spec = os.environ.get("BENCH_PARK_DEPTH", "").strip()
+    if not spec:
+        return None
+    depths = [int(d) for d in spec.split(",") if d.strip()]
+    if not depths:
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+    from sparkdl_tpu.serving import ContinuousGPTEngine
+
+    plen = int(os.environ.get("BENCH_PARK_PROMPT_LEN", "320"))
+    turn1_new = 8
+    turn2_new = 4
+    kv_bs = 32
+    # device pool sized for ~2 live sessions; the host tier is where
+    # the fleet actually lives
+    kv_blocks = int(os.environ.get("BENCH_PARK_KV_BLOCKS", "24"))
+    host_blocks = int(os.environ.get("BENCH_PARK_HOST_BLOCKS", "512"))
+    max_len = plen + turn1_new + turn2_new + kv_bs
+    cfg = GPTConfig(
+        vocab_size=256, hidden_size=128, num_layers=3, num_heads=4,
+        intermediate_size=256, max_seq_len=2 * max_len,
+    )
+    model = GPTLMHeadModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    # worst-case blocks one session pins while decoding turn 2
+    per_session = -(-(plen + turn1_new + turn2_new + 1) // kv_bs)
+    device_live = kv_blocks // per_session
+    kw = dict(n_slots=2, max_len=max_len, kv_layout="paged",
+              kv_block_size=kv_bs, idle_wait_s=0.0005)
+
+    def pctl(xs, q):
+        return round(float(np.percentile(np.asarray(xs), q)) * 1e3, 2)
+
+    out = {
+        "prompt_len": plen,
+        "kv_blocks": kv_blocks,
+        "kv_block_size": kv_bs,
+        "host_kv_blocks": host_blocks,
+        "device_live_sessions": device_live,
+        "depths": [],
+    }
+    for depth in depths:
+        rng = np.random.default_rng(18 + depth)
+        prompts = [rng.integers(1, cfg.vocab_size, plen).tolist()
+                   for _ in range(depth)]
+
+        # -- resume arm: turn 1 fills the host tier, turn 2 restores
+        eng = ContinuousGPTEngine(cfg, variables,
+                                  kv_blocks=kv_blocks,
+                                  host_kv_blocks=host_blocks, **kw)
+        # warm cycle: one throwaway conversation parked and resumed so
+        # the park/unpark install programs and the suffix-width chunk
+        # compile OUTSIDE the measured resumes
+        wp = rng.integers(1, cfg.vocab_size, plen).tolist()
+        wr = eng.submit(wp, turn1_new).result(timeout=600).tolist()
+        eng.park_cold()
+        eng.submit(wp + wr + [5], turn2_new).result(timeout=600)
+        futs = [eng.submit(p, turn1_new) for p in prompts]
+        replies = [f.result(timeout=600).tolist() for f in futs]
+        eng.park_cold()
+        cap = eng.capacity()
+        parked_sessions = cap["kv_parked_sessions"]
+        parked_blocks = cap["kv_parked_blocks"]
+        tiers_peak = eng._kv_snapshot()["tiers"]
+        turn2 = [p + r + [5] for p, r in zip(prompts, replies)]
+        lat_resume = []
+        for t in turn2:
+            t0 = time.perf_counter()
+            eng.submit(t, turn2_new).result(timeout=600)
+            lat_resume.append(time.perf_counter() - t0)
+        tiers = eng._kv_snapshot()["tiers"]
+        eng.close()
+
+        # -- re-prefill arm: the same turn-2 transcripts served cold
+        # by an untiered engine (what losing the session's KV costs)
+        base = ContinuousGPTEngine(cfg, variables,
+                                   kv_blocks=kv_blocks, **kw)
+        base.submit(turn2[0][:plen], 2).result(timeout=600)  # warm
+        lat_cold = []
+        for t in turn2:
+            t0 = time.perf_counter()
+            base.submit(t, turn2_new).result(timeout=600)
+            lat_cold.append(time.perf_counter() - t0)
+        base.close()
+
+        out["depths"].append({
+            "depth": depth,
+            "turn_resume_p50_ms": pctl(lat_resume, 50),
+            "turn_resume_p95_ms": pctl(lat_resume, 95),
+            "reprefill_p50_ms": pctl(lat_cold, 50),
+            "reprefill_p95_ms": pctl(lat_cold, 95),
+            "resume_speedup_p50": (
+                round(pctl(lat_cold, 50) / pctl(lat_resume, 50), 4)
+                if pctl(lat_resume, 50) else None),
+            "parked_sessions": parked_sessions,
+            "parked_sessions_per_chip": parked_sessions,
+            "parked_blocks": parked_blocks,
+            "tier_blocks": {
+                "host": tiers_peak.get("host_blocks"),
+                "disk": tiers_peak.get("disk_blocks"),
+            },
+            "unparks": tiers.get("unparks"),
+            "park_fallbacks": tiers.get("park_fallbacks"),
+        })
     return out
 
 
@@ -1028,6 +1162,11 @@ def main() -> None:
     # KV-block handoff (BENCH_DISAGG=1 enables).
     disagg = _disagg_section()
 
+    # Tiered KV session parking (ISSUE 18): turn-2 resume from the
+    # host tier vs full re-prefill at each BENCH_PARK_DEPTH (empty
+    # disables).
+    park = _gpt_park_section()
+
     gap = calibrate_dispatch_gap()
     n_dispatches = dispatch_count("serving")
     snap_wall = registry().snapshot().get(
@@ -1115,6 +1254,20 @@ def main() -> None:
         # interactive e2e median (None when BENCH_DISAGG != 1)
         "phase_breakdown": (disagg or {}).get("phase_breakdown"),
         "disagg": disagg,
+        # Tiered KV cache (ISSUE 18): turn-2 resume latency from the
+        # parked host tier vs re-prefilling the transcript, and the
+        # idle sessions one chip's pools can hold vs device HBM alone
+        # (None when BENCH_PARK_DEPTH is unset)
+        "turn_resume_p50_ms": (
+            (park or {}).get("depths") or [{}])[-1].get(
+                "turn_resume_p50_ms"),
+        "reprefill_p50_ms": (
+            (park or {}).get("depths") or [{}])[-1].get(
+                "reprefill_p50_ms"),
+        "parked_sessions_per_chip": (
+            (park or {}).get("depths") or [{}])[-1].get(
+                "parked_sessions_per_chip"),
+        "park": park,
         # SLO accounting + flight recorder (ISSUE 9): declared objective
         # with rolling burn, and the event-ring volume this run produced
         "slo": replica_snap.get("slo"),

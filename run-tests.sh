@@ -576,8 +576,11 @@ EOF
 # BENCH_DISAGG=1: the disaggregated-serving section must show the
 # 3072-token prompt stream NOT moving interactive p95 past the
 # colocated stall, and the int8 handoff moving >=3.5x fewer bytes.
+# BENCH_PARK_DEPTH: the tiered-KV section must show turn-2 resume
+# beating re-prefill at both depths with >=4x device-only sessions
+# parked per chip.
 JAX_PLATFORMS=cpu BENCH_REQUESTS=64 BENCH_SPEC_K=4 BENCH_KV_DTYPE=int8 \
-  BENCH_AUTOSCALE=1 BENCH_DISAGG=1 \
+  BENCH_AUTOSCALE=1 BENCH_DISAGG=1 BENCH_PARK_DEPTH=8,16 \
   python bench_serving.py | tail -1 | python -c '
 import json, os, sys
 rec = json.loads(sys.stdin.readline())
@@ -702,8 +705,27 @@ assert pb["interactive_p50_s"] > 0, pb
 assert abs(pb["sum_p50_s"] - pb["interactive_p50_s"]) <= \
     0.5 * pb["interactive_p50_s"] + 0.05, pb
 assert "sparkdl_request_phase_seconds" in obs, sorted(obs)
+# ISSUE 18: tiered KV parking — resuming a parked conversation must
+# beat re-prefilling its transcript at EVERY swept depth, the host
+# tier must hold >= 4x the sessions device HBM alone keeps live, no
+# park fell back, and the tier metric families ride the spine
+pk = rec["park"]
+assert len(pk["depths"]) >= 2, pk
+for d in pk["depths"]:
+    assert d["turn_resume_p50_ms"] < d["reprefill_p50_ms"], d
+    assert d["parked_sessions_per_chip"] >= \
+        4 * pk["device_live_sessions"], d
+    assert d["tier_blocks"]["host"] > 0, d
+    assert d["unparks"] > 0, d
+    assert d["park_fallbacks"] == 0, d
+assert rec["turn_resume_p50_ms"] < rec["reprefill_p50_ms"], rec
+assert rec["parked_sessions_per_chip"] >= \
+    4 * pk["device_live_sessions"], rec
+assert "sparkdl_kv_tier_blocks" in obs, sorted(obs)
+assert "sparkdl_kv_parks_total" in obs, sorted(obs)
+assert "sparkdl_kv_unparks_total" in obs, sorted(obs)
 print("bench_serving contract OK (snapshot + slo + flight + kv + spec "
-      "+ sp + fabric + autoscale + disagg + phases embedded)")
+      "+ sp + fabric + autoscale + disagg + phases + park embedded)")
 '
 
 # Paged-KV smoke (ISSUE 10): (a) a shared-prefix workload through the
@@ -853,6 +875,71 @@ print("spec-decode smoke OK: k=4 bitwise vs k=1 through 2 injected "
       "verify failures (zero lost requests), kv.quantize fails the "
       f"int8 build loudly, int8 fits {kv_capacity_ratio(cfg, 'int8'):.1f}x "
       "fp32 tokens per byte")
+EOF
+
+# Tiered-KV park smoke (ISSUE 18): (a) 8 sessions squeezed through a
+# device pool holding ~2 live sessions park to the host tier under
+# admission pressure (plus a park_cold flush), and every turn-2 resume
+# stays BITWISE vs an engine that never parked; (b) the same soak with
+# kv.park faults injected mid-run falls back to plain eviction — ZERO
+# lost requests, tokens still bitwise, the failures on the flight ring.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+from sparkdl_tpu.observability.flight import flight_recorder
+from sparkdl_tpu.reliability.faults import inject
+from sparkdl_tpu.serving import ContinuousGPTEngine
+
+cfg = GPTConfig.tiny()
+model = GPTLMHeadModel(cfg)
+variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+kw = dict(n_slots=2, max_len=32, kv_block_size=4, kv_layout="paged",
+          idle_wait_s=0.0005)
+rng = np.random.default_rng(18)
+prompts = [rng.integers(1, cfg.vocab_size, 9).tolist() for _ in range(8)]
+
+def two_turns(eng, park):
+    replies = [eng.submit(p, 4).result(timeout=120).tolist()
+               for p in prompts]
+    if park:
+        eng.park_cold()
+    outs = [eng.submit(p + r + [5], 4).result(timeout=120).tolist()
+            for p, r in zip(prompts, replies)]
+    return replies, outs
+
+# (a) pressure-parked sessions resume bitwise vs a roomy untiered pool
+eng = ContinuousGPTEngine(cfg, variables, kv_blocks=10,
+                          host_kv_blocks=64, **kw)
+r_park, o_park = two_turns(eng, park=True)
+tiers = eng._kv_snapshot()["tiers"]
+assert tiers["parks"] > 0, tiers
+assert tiers["unparks"] > 0, tiers
+assert tiers["park_fallbacks"] == 0, tiers
+eng.close()
+ref = ContinuousGPTEngine(cfg, variables, kv_blocks=64, **kw)
+r_ref, o_ref = two_turns(ref, park=False)
+ref.close()
+assert r_park == r_ref and o_park == o_ref, "parked-resume diverged"
+
+# (b) torn parks mid-soak: eviction fallback, zero lost, still bitwise
+base = flight_recorder().events_total
+eng = ContinuousGPTEngine(cfg, variables, kv_blocks=10,
+                          host_kv_blocks=64, **kw)
+with inject("kv.park:RuntimeError@2*2"):
+    r_chaos, o_chaos = two_turns(eng, park=True)
+fb = eng._kv_snapshot()["tiers"]["park_fallbacks"]
+assert fb >= 1, fb
+eng.close()
+assert r_chaos == r_ref and o_chaos == o_ref, "chaos soak diverged"
+evs = [e for e in flight_recorder().events()
+       if e["kind"] == "kv.park_failed" and e["seq"] > base]
+assert evs, "kv.park failure missing from the flight ring"
+print(f"tiered-KV park smoke OK: {tiers['parks']} parks / "
+      f"{tiers['unparks']} unparks bitwise across 8 sessions on a "
+      f"10-block device pool; {fb} torn parks fell back to eviction "
+      "with zero lost requests")
 EOF
 
 # Fault-injection smoke (ISSUE 5): resumable_finetune survives an

@@ -279,6 +279,10 @@ class AutoScaler:
         self._streak_dir = 0
         self._streak = 0
         self._cooldown = 0
+        #: consecutive quiet ticks where a KV shrink was blocked by
+        #: the host tier's unpark reservations (ROADMAP item 1) —
+        #: snapshot()/healthz read >0 as degraded; self-clearing
+        self._kv_shrink_blocked_streak = 0
         #: direction ("up"/"down") -> ticks it stays blocked
         self._tabu: "dict[str, int]" = {}
         #: armed scale-downs awaiting their SLO-burn verdict
@@ -539,11 +543,28 @@ class AutoScaler:
             if not quiet:
                 return 0
             n = pool.shrink(step)
+            reserved = getattr(pool, "unpark_reserved", 0)
         if n:
+            self._kv_shrink_blocked_streak = 0
             self._record("kv", "down", blocks=n,
                          spare=pool.spare_count)
             self._arm_veto("kv", {"blocks": n})
             return 1
+        if reserved > 0:
+            # quiet by every signal, yet shrink moved nothing: the
+            # host tier's unpark reservations hold the floor (ROADMAP
+            # item 1). Defer — scaling down now would strand parked
+            # sessions' resumes behind re-prefills. The streak reads
+            # as degraded in healthz and self-clears when sessions
+            # resume (reservations drop) or the next shrink lands.
+            self._kv_shrink_blocked_streak += 1
+            if self._kv_shrink_blocked_streak == 1:
+                # record the episode's start, not every blocked tick —
+                # the streak in snapshot()/healthz carries the duration
+                self._record("kv", "shrink_blocked",
+                             unpark_reserved=reserved)
+        else:
+            self._kv_shrink_blocked_streak = 0
         return 0
 
     def _converge_pin(self) -> int:
@@ -650,6 +671,9 @@ class AutoScaler:
                 "free": self.kv_pool.free_count,
                 "need_peak": self.kv_pool.need_peak,
                 "deferral_streak": self.kv_pool.deferral_streak,
+                "unpark_reserved": getattr(
+                    self.kv_pool, "unpark_reserved", 0),
+                "shrink_blocked_streak": self._kv_shrink_blocked_streak,
             }
         return {"autoscaler": {
             "state": self.state,

@@ -143,7 +143,7 @@ class _HostState:
     __slots__ = ("handle", "host_id", "outstanding", "routed",
                  "breaker", "draining", "health_status", "digest",
                  "weight", "saturation", "free_slots", "kv_free",
-                 "kv_total")
+                 "kv_total", "kv_cold", "kv_parked_sessions")
 
     def __init__(self, handle: HostHandle, saturation: "int | None",
                  breaker: ProbationBreaker):
@@ -165,6 +165,11 @@ class _HostState:
         self.free_slots: "int | None" = None
         self.kv_free: "int | None" = None
         self.kv_total: "int | None" = None
+        #: tiered-KV signals (ROADMAP item 1): refcount-0 cached
+        #: blocks that can page out on demand, and sessions already
+        #: parked in the host/disk tiers — pressure that is NOT "full"
+        self.kv_cold: "int | None" = None
+        self.kv_parked_sessions: "int | None" = None
 
     # breaker state read-throughs (tests and snapshots read these; all
     # WRITES go through the breaker's transition verbs)
@@ -468,14 +473,18 @@ class Router:
             # request, so it scores like a busy one. The router-side
             # outstanding count keeps the score live between capacity
             # refreshes; the load penalty breaks ties the stale
-            # free-slot reading cannot.
+            # free-slot reading cannot. Cold cached blocks count as
+            # available (ROADMAP item 1): a tiered host pages them out
+            # on demand, so pressure that is parkable idle sessions
+            # must not score the host as full.
             def room(s: _HostState) -> float:
                 free = (s.free_slots if s.free_slots is not None
                         else s.weight)
                 free = max(0.0, free - s.outstanding)
                 kv = 1.0
                 if s.kv_total:
-                    kv = max(0.0, s.kv_free or 0) / s.kv_total
+                    avail = max(0.0, s.kv_free or 0) + (s.kv_cold or 0)
+                    kv = min(1.0, avail / s.kv_total)
                 return free * kv
 
             scores = {
@@ -656,6 +665,11 @@ class Router:
             state.kv_free = int(kf) if kf is not None else None
             kt = cap.get("kv_blocks_total")
             state.kv_total = int(kt) if kt is not None else None
+            kc = cap.get("kv_blocks_cold")
+            state.kv_cold = int(kc) if kc is not None else None
+            ps = cap.get("kv_parked_sessions")
+            state.kv_parked_sessions = (int(ps) if ps is not None
+                                        else None)
             state.health_status = str(
                 health.get("status") or "ok")
             # gauge published under the same lock as the membership
@@ -934,6 +948,8 @@ class Router:
                     "free_slots": s.free_slots,
                     "kv_free": s.kv_free,
                     "kv_total": s.kv_total,
+                    "kv_cold": s.kv_cold,
+                    "kv_parked_sessions": s.kv_parked_sessions,
                     "consecutive_failures": s.consecutive_failures,
                     "digest_blocks": (len(s.digest.hashes)
                                       if s.digest is not None else 0),

@@ -576,8 +576,18 @@ def healthz_report() -> dict:
                 # or deferred by a fault): degraded, never unhealthy —
                 # the controller retries/recovers on its own cadence
                 status = "degraded"
+            kv_as = a.get("kv")
+            if (isinstance(kv_as, dict)
+                    and int(kv_as.get("shrink_blocked_streak") or 0) > 0
+                    and status == "ok"):
+                # scale-down is deferring because parked sessions hold
+                # unpark reservations (ROADMAP item 1): degraded, never
+                # unhealthy — clears when sessions resume or drop
+                status = "degraded"
         if isinstance(out, dict) and isinstance(out.get("kv_pool"), dict):
             kvp = out["kv_pool"]
+            tiers = kvp.get("tiers") if isinstance(
+                kvp.get("tiers"), dict) else None
             kv_pools.append({
                 "provider": name,
                 "blocks_total": kvp.get("blocks_total"),
@@ -585,6 +595,12 @@ def healthz_report() -> dict:
                 "blocks_cached": kvp.get("blocks_cached"),
                 "deferrals_total": kvp.get("deferrals_total"),
                 "exhausted_streak": kvp.get("exhausted_streak"),
+                # tier occupancy (ROADMAP item 1): how much of this
+                # engine's session state sits in the cheap tiers
+                **({"host_tier_blocks": tiers.get("host_blocks"),
+                    "disk_tier_blocks": tiers.get("disk_blocks"),
+                    "parked_sessions": tiers.get("parked_sessions"),
+                    } if tiers is not None else {}),
             })
             if int(kvp.get("exhausted_streak") or 0) > 0 \
                     and status == "ok":

@@ -86,6 +86,8 @@ KNOWN_SITES = (
     "handoff.export",
     "handoff.install",
     "worker.rank",
+    "kv.park",
+    "kv.unpark",
 )
 
 _M_INJECTED = None

@@ -301,6 +301,9 @@ class ContinuousGPTEngine:
                  spec_k: "int | None" = None,
                  draft_source: Any = None,
                  kv_dtype: str = "fp32",
+                 host_kv_blocks: "int | None" = None,
+                 disk_kv_blocks: "int | None" = None,
+                 kv_spill_dir: "str | None" = None,
                  metrics: ServingMetrics | None = None,
                  slo: "slo_mod.SLO | None" = None,
                  host_id: "str | None" = None,
@@ -338,6 +341,24 @@ class ContinuousGPTEngine:
                 "(kv_dtype) require kv_layout='paged'; the dense layout "
                 "is the exact parity oracle"
             )
+        if kv_layout != "paged" and host_kv_blocks is not None:
+            raise ValueError(
+                "tiered KV (host_kv_blocks) requires kv_layout='paged': "
+                "parking pages pool blocks, and the dense layout has "
+                "no block pool"
+            )
+        if disk_kv_blocks is not None and host_kv_blocks is None:
+            raise ValueError(
+                "disk_kv_blocks requires host_kv_blocks: the disk tier "
+                "sits below the host tier (blocks spill host->disk, "
+                "never device->disk directly)"
+            )
+        if host_kv_blocks is not None and host_kv_blocks < 1:
+            raise ValueError(
+                f"host_kv_blocks must be >= 1, got {host_kv_blocks}")
+        if disk_kv_blocks is not None and disk_kv_blocks < 0:
+            raise ValueError(
+                f"disk_kv_blocks must be >= 0, got {disk_kv_blocks}")
         if sp is not None and sp < 1:
             raise ValueError(f"sp must be >= 1, got {sp}")
         # Resolve the env pin HERE, before layout validation, so
@@ -401,6 +422,10 @@ class ContinuousGPTEngine:
         self._prefill_seconds = 0.0
         self._prefill_chunks = 0
         self._deferrals = 0
+        #: host/disk tier store for parked cold sessions (ROADMAP
+        #: item 1); None = flat single-tier cache (the default)
+        self._kv_tiers = None
+        self._park_fallbacks = 0
         self._max_tick_prefill_tokens = 0
         self._prefill_rr = 0
         self._lock = threading.Lock()
@@ -468,7 +493,18 @@ class ContinuousGPTEngine:
             #: which pool the last deferral was short on (_defer reads
             #: it; the sp staging branch points it at _sp_pool)
             self._defer_pool = self._pool
-            self._prefix = PrefixCache(self._pool)
+            if host_kv_blocks is not None:
+                from sparkdl_tpu.serving.kv_tiers import TieredKVStore
+
+                # disk overflow may only drop trie LEAVES — dropping
+                # an interior parked node would orphan its (parked)
+                # descendants' payloads
+                self._kv_tiers = TieredKVStore(
+                    host_kv_blocks, disk_kv_blocks or 0,
+                    spill_dir=kv_spill_dir,
+                    is_droppable=lambda node: not node.children)
+            self._prefix = PrefixCache(self._pool,
+                                       tiers=self._kv_tiers)
             self._draft = (draft_source if draft_source is not None
                            else default_draft_source(self._prefix))
             self._pool_kv = init_block_pool(config, kv_blocks, bs_kv,
@@ -696,12 +732,39 @@ class ContinuousGPTEngine:
                     variables, ck, cv, idx, ids, cols)
                 return logits, _installed(pool, ck, cv, inst)
 
+            @jax.jit
+            def _park_fetch(pool, ids):
+                # the D2H half of a park: the given blocks' RAW
+                # storage-dtype bytes (int8 codes + their scales, no
+                # dequantize) — raw is both the 4x cheaper transfer
+                # the quantized layout bought and what makes a resumed
+                # session bitwise-identical: unpark writes back the
+                # exact bytes decode would have read
+                out = {"k": pool["k"][:, ids], "v": pool["v"][:, ids]}
+                if kv_dtype == "int8":
+                    out["k_scale"] = pool["k_scale"][:, ids]
+                    out["v_scale"] = pool["v_scale"][:, ids]
+                return out
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def _unpark_install(pool, ids, payload):
+                # the H2D half of a resume: whole-block raw writes
+                # into freshly allocated blocks (sentinel ids drop —
+                # same contract as every other pool write)
+                out = dict(pool)
+                for name, vals in payload.items():
+                    out[name] = pool[name].at[:, ids].set(
+                        vals.astype(pool[name].dtype), mode="drop")
+                return out
+
             self._paged_step_fn = _paged_step
             self._paged_verify_fn = _paged_verify
             self._chunk_one_fn = _chunk_one
             self._chunk_first_fn = _chunk_first
             self._chunk_mid_fn = _chunk_mid
             self._chunk_final_fn = _chunk_final
+            self._park_fetch_fn = _park_fetch
+            self._unpark_install_fn = _unpark_install
             # the sp handoff/prefix programs reuse the dtype boundary
             self._dq_gather_fn = _dq_gather
             self._q_write_fn = _q_write
@@ -1079,6 +1142,8 @@ class ContinuousGPTEngine:
             self._pool.close()
             if self.sp > 1:
                 self._sp_pool.close()
+            if self._kv_tiers is not None:
+                self._kv_tiers.close()
 
     def begin_drain(self) -> "list[Request]":
         """Graceful host drain, phase one (ISSUE 14): stop admission and
@@ -1315,9 +1380,27 @@ class ContinuousGPTEngine:
         nb_total = -(-(plen
                        + self._admission_budget_tokens(gen.max_new_tokens))
                      // self._kv_bs)
+        # turn resume: page any parked prefix of this prompt back in
+        # BEFORE matching, so the match below sees device blocks and
+        # the resume costs one H2D copy per block instead of a
+        # re-prefill. Restored blocks hold a temporary reference
+        # (restore allocation may demote OTHER cold leaves, never
+        # these) released as soon as match has taken its own.
+        restored: "list[int]" = []
+        if self._kv_tiers is not None:
+            restored = self._prefix.restore_path(
+                toks[:-1], alloc_block=self._alloc_one_block,
+                install=self._install_parked)
+            self._update_unpark_reserved()
+            if restored:
+                flight_mod.record_event(
+                    "kv.unparked", request_id=req.request_id,
+                    blocks=len(restored))
         # the last prompt token must always prefill — the cache holds
         # K/V, not the logits that seed decode
         m = self._prefix.match(toks[:-1])
+        if restored:
+            self._prefix.release(restored)
         matched = (m.full_blocks
                    + ([m.partial_block] if m.partial_block is not None
                       else []))
@@ -1423,9 +1506,105 @@ class ContinuousGPTEngine:
         got = self._pool.allocate(n)
         if got is None:
             short = n - self._pool.free_count
-            if self._prefix.evict(short) >= short:
+            if self._kv_tiers is not None:
+                # tiered: page cold leaves OUT (device->host->disk)
+                # instead of discarding them — the demoted sessions
+                # resume with one H2D copy, not a re-prefill
+                freed = self._prefix.demote(short, self._park_payload)
+                self._update_unpark_reserved()
+            else:
+                freed = self._prefix.evict(short)
+            if freed >= short:
                 got = self._pool.allocate(n)
         return got
+
+    def _alloc_one_block(self) -> "int | None":
+        got = self._alloc_blocks(1)
+        return got[0] if got else None
+
+    # -- tiered park/resume (ROADMAP item 1) ----------------------------------
+    def _park_payload(self, bid: int) -> "dict | None":
+        """D2H-fetch one cold block's raw bytes for parking. None =
+        torn park (injected ``kv.park`` fault or transfer failure):
+        the caller falls back to plain eviction — the session simply
+        re-prefills next turn, nothing is lost."""
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.runtime.completion import start_fetch
+        from sparkdl_tpu.serving import kv_tiers as kv_tiers_mod
+
+        t0 = time.monotonic()
+        try:
+            fault_point("kv.park")
+            tree = self._park_fetch_fn(
+                self._pool_kv, jnp.asarray([bid], jnp.int32))
+            ticket = start_fetch(tree, path="kv_park")
+            # sparkdl-lint: disable=blocking-in-hot-loop -- a park only runs when allocation already came up short, and the copy is one block (the alternative, plain eviction, costs that session a full re-prefill)
+            fetched = ticket.result()
+        except Exception as e:
+            self._park_fallbacks += 1
+            kv_tiers_mod._M_FALLBACKS.inc(op="park")
+            flight_mod.record_event(
+                "kv.park_failed", error=type(e).__name__, block=bid)
+            return None
+        payload = {name: np.asarray(v)[:, 0]
+                   for name, v in fetched.items()}
+        kv_tiers_mod._M_PARK_SEC.observe(time.monotonic() - t0)
+        return payload
+
+    def _install_parked(self, bid: int, payload: dict) -> bool:
+        """H2D-install one parked block's raw bytes into a fresh pool
+        block. False = corrupt unpark (injected ``kv.unpark`` fault):
+        the caller prunes the parked subtree and the suffix
+        re-prefills — the request still completes."""
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.serving import kv_tiers as kv_tiers_mod
+
+        t0 = time.monotonic()
+        try:
+            fault_point("kv.unpark")
+            tree = {name: jnp.asarray(np.asarray(v)[:, None])
+                    for name, v in payload.items()}
+            # sparkdl-lint: disable=lock-discipline -- only reachable from _admit_paged's restore_path callback, which the admission loop enters holding self._lock
+            self._pool_kv = self._unpark_install_fn(
+                self._pool_kv, jnp.asarray([bid], jnp.int32), tree)
+        except Exception as e:
+            self._park_fallbacks += 1
+            kv_tiers_mod._M_FALLBACKS.inc(op="unpark")
+            flight_mod.record_event(
+                "kv.unpark_failed", error=type(e).__name__, block=bid)
+            return False
+        kv_tiers_mod._M_UNPARK_SEC.observe(time.monotonic() - t0)
+        return True
+
+    def _update_unpark_reserved(self) -> None:
+        """Tell the pool how many free blocks parked state expects to
+        claim on resume, so the autoscaler's shrink defers instead of
+        stranding unparks behind re-prefills (capped at the pool —
+        over-subscription past that is already a full pool)."""
+        if self._kv_tiers is None:
+            return
+        s = self._kv_tiers.stats()
+        self._pool.unpark_reserved = min(
+            s["host_blocks"] + s["disk_blocks"], self._pool.n_blocks)
+
+    def park_cold(self, max_blocks: "int | None" = None) -> int:
+        """Explicitly page every currently cold cached block out to
+        the host tier (benches/tests; production parks lazily under
+        allocation pressure). Returns device blocks freed. Refcounted
+        shares and partial-block COW donors never park."""
+        if self._kv_tiers is None:
+            raise RuntimeError(
+                "park_cold needs a host tier: construct the engine "
+                "with host_kv_blocks")
+        with self._lock:
+            n = (max_blocks if max_blocks is not None
+                 else self._prefix.cached_blocks)
+            freed = self._prefix.demote(
+                n, self._park_payload, evict_fallback=False)
+            self._update_unpark_reserved()
+            return freed
 
     def _prefill_tick(self) -> None:
         """Advance chunked prefills by at most ``prefill_chunk`` REAL
@@ -1932,8 +2111,26 @@ class ContinuousGPTEngine:
                 **({"error": type(error).__name__} if error else {}),
             )
 
+    def _register_session(self, slot: int, flight: _InFlight) -> None:
+        """Index the finished turn's whole sequence — prompt plus
+        produced tokens minus the last (columns ``[0, pidx)`` hold
+        exactly the KV of ``prompt + produced[:-1]``, the _pidx
+        invariant) — so the session's NEXT turn, whose prompt embeds
+        this turn verbatim, parks and resumes instead of
+        re-prefilling. Tiered engines only: without a park tier the
+        extra registrations would just bloat the LRU."""
+        seq = (tuple(int(t) for t in flight.prompt)
+               + tuple(int(t) for t in flight.produced[:-1]))
+        if not seq:
+            return
+        nb = -(-len(seq) // self._kv_bs)
+        row = self._table[slot]
+        self._prefix.register(seq, [int(b) for b in row[:nb]])
+
     def _complete(self, slot: int) -> None:
         flight = self._inflight.pop(slot)
+        if self._kv_tiers is not None:
+            self._register_session(slot, flight)
         self._release_slot(slot, flight.blocks)
         now = time.monotonic()
         self._record_request_span(
@@ -2058,6 +2255,13 @@ class ContinuousGPTEngine:
                 "shard_used": self._sp_pool.shard_used_counts(),
                 "handoffs": self._sp_handoffs,
             }} if self.sp > 1 else {}),
+            # host/disk tier occupancy rides the same snapshot into
+            # the flight recorder's pool-pressure context and healthz
+            **({"tiers": {
+                **(self._prefix.tier_stats() or {}),
+                "park_fallbacks": self._park_fallbacks,
+                "unpark_reserved": self._pool.unpark_reserved,
+            }} if self._kv_tiers is not None else {}),
         }
 
     def _spec_snapshot(self) -> "dict[str, Any] | None":
@@ -2111,6 +2315,24 @@ class ContinuousGPTEngine:
         separately. Best-effort reads (no engine lock): routing weights
         tolerate a tick of staleness."""
         paged = self.kv_layout == "paged"
+        # parkable pressure split (ROADMAP item 1): cold = refcount-0
+        # cached blocks that COULD page out on demand, parked = blocks
+        # already in the host/disk tiers. A router that reads only
+        # kv_blocks_free scores a host full when its pressure is
+        # actually idle sessions — the headroom policy folds these in.
+        cold = parked = sessions = None
+        if paged:
+            try:
+                cold = self._prefix.cold_blocks()
+            except RuntimeError:
+                cold = None  # racing registration: stale next refresh
+            if self._kv_tiers is not None:
+                s = self._kv_tiers.stats()
+                parked = s["host_blocks"] + s["disk_blocks"]
+                try:
+                    sessions = self._prefix.parked_sessions()
+                except RuntimeError:
+                    sessions = None
         return {
             "host_id": self.host_id,
             "replica_count": 1,
@@ -2119,6 +2341,9 @@ class ContinuousGPTEngine:
                            - len(self._prefilling)),
             "kv_blocks_free": self._pool.free_count if paged else None,
             "kv_blocks_total": self._pool.n_blocks if paged else None,
+            "kv_blocks_cold": cold,
+            "kv_parked_blocks": parked,
+            "kv_parked_sessions": sessions,
             "queue_depth": self.queue.depth,
             "max_queue_depth": self.queue.max_depth,
             "draining": self.queue.closed,

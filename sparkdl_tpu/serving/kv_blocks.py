@@ -167,6 +167,13 @@ class KVBlockPool:
         #: returns them to service (the device pool array is untouched;
         #: spare is host-side admission bookkeeping)
         self._spare: "list[int]" = []
+        #: free blocks the host tier expects to claim for unparks
+        #: (ROADMAP item 1): parked sessions resume with one block
+        #: allocation per parked block, so :meth:`shrink` must leave
+        #: this many free on top of :attr:`need_peak` or scale-down
+        #: strands resumes behind re-prefills. Maintained by the
+        #: engine under its lock (0 when tiering is off).
+        self.unpark_reserved = 0
         self._closed = False
         self._g_total = GaugeShare(_M_TOTAL)
         self._g_used = GaugeShare(_M_USED)
@@ -333,16 +340,19 @@ class KVBlockPool:
         """Park up to ``n`` FREE blocks as spare capacity (scale-down).
         Guard: the free list is never shrunk below the worst
         single-admission need this pool ever recorded
-        (:attr:`need_peak`, fed by :meth:`record_deferral`) — spare
-        capacity must not manufacture the exhaustion it exists to
-        absorb. Returns the blocks actually moved (possibly 0)."""
+        (:attr:`need_peak`, fed by :meth:`record_deferral`) *plus* the
+        host tier's :attr:`unpark_reserved` — spare capacity must not
+        manufacture the exhaustion it exists to absorb, nor strand a
+        parked session's resume behind a re-prefill. Returns the
+        blocks actually moved (possibly 0)."""
         from sparkdl_tpu.reliability.faults import fault_point
 
         fault_point("kv_pool.resize")
         if n < 0:
             raise ValueError(f"cannot shrink by {n} blocks")
-        allowance = self.free_count - max(self._deferred_need,
-                                          self.need_peak)
+        allowance = (self.free_count
+                     - max(self._deferred_need, self.need_peak)
+                     - self.unpark_reserved)
         moved = max(0, min(n, allowance))
         for _ in range(moved):
             self._spare.append(self._take_free_block())

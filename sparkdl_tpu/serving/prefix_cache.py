@@ -26,6 +26,21 @@ leave an unmatchable dangling suffix), invoked by the engine when
 allocation comes up short. Unregistered blocks free immediately at
 refcount zero.
 
+Tiered mode (ROADMAP item 1): when the engine attaches a
+:class:`~sparkdl_tpu.serving.kv_tiers.TieredKVStore`, the trie becomes
+a **3-level hierarchy**. A node's ``tier`` says where its block lives:
+``"device"`` nodes hold a live pool block; ``"host"``/``"disk"`` nodes
+are *parked* — their raw block bytes moved to the cheap tier, their
+``block_id`` invalid, their trie position (and token key) intact so the
+next turn can find them. One eviction policy covers all levels:
+:meth:`demote` pages cold device leaves out (device→host, cascading
+host→disk, dropping from disk last), refcounted shares and partial-
+holding nodes never park, and :meth:`restore_path` pages a parked
+prefix back in ahead of :meth:`match` so a turn resume costs one H2D
+copy instead of a re-prefill. A parked node's children are always
+parked too (children park before parents; restore revives parents
+before children), which is what makes dropping a parked subtree safe.
+
 All bookkeeping runs under the engine lock — host-side scheduling,
 no device work. Spine metrics: ``sparkdl_prefix_hits_total`` /
 ``sparkdl_prefix_misses_total`` count prompt TOKENS served from cache
@@ -37,10 +52,11 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import itertools
-from typing import Any, Optional
+from typing import Any, Callable, Dict, Optional
 
 from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.serving.kv_blocks import KVBlockPool
+from sparkdl_tpu.serving.kv_tiers import TieredKVStore
 
 _M_HITS = registry().counter(
     "sparkdl_prefix_hits_total",
@@ -90,7 +106,7 @@ class _Node:
     the root-to-node path spells the whole prefix."""
 
     __slots__ = ("key", "block_id", "parent", "children", "partials",
-                 "stamp")
+                 "stamp", "tier")
 
     def __init__(self, key, block_id, parent, stamp):
         self.key = key
@@ -99,6 +115,9 @@ class _Node:
         self.children: "dict[tuple, _Node]" = {}
         self.partials: "list[_Partial]" = []
         self.stamp = stamp
+        #: "device" | "host" | "disk" — parked nodes keep their trie
+        #: position but hold no pool block (block_id is invalid)
+        self.tier = "device"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -119,18 +138,24 @@ class PrefixMatch:
 class PrefixCache:
     """Token-trie prefix index over a :class:`KVBlockPool`."""
 
-    def __init__(self, pool: KVBlockPool):
+    def __init__(self, pool: KVBlockPool,
+                 tiers: "Optional[TieredKVStore]" = None):
         self.pool = pool
         self.block_size = pool.block_size
         self._clock = itertools.count(1)
         self._root = _Node(None, -1, None, 0)
         #: block_id -> _Node | _Partial for every trie-registered block
+        #: whose bytes are DEVICE-resident (parked nodes leave this map)
         self._registered: "dict[int, Any]" = {}
+        #: host/disk tiers for parked nodes (None = flat single-tier)
+        self._tiers = tiers
         # engine-visible counters (the registry families are process
         # totals; benches/snapshots want this engine's share)
         self.hit_tokens = 0
         self.miss_tokens = 0
         self.evictions = 0
+        self.parks = 0
+        self.unparks = 0
 
     # -- lookup --------------------------------------------------------------
     @property
@@ -149,7 +174,11 @@ class PrefixCache:
         i = 0
         while len(tokens) - i >= bs:
             child = node.children.get(tokens[i:i + bs])
-            if child is None:
+            if child is None or child.tier != "device":
+                # parked child: the bytes are a tier away, not usable
+                # as KV — restore_path() runs before match on the
+                # tiered admission path, so hitting one here means the
+                # restore fell short (re-prefill the rest)
                 break
             full.append(child.block_id)
             node = child
@@ -267,24 +296,54 @@ class PrefixCache:
         tokens ``[i*bs, (i+1)*bs)`` (the slot's table prefix — shared
         blocks walk existing nodes, owned blocks become new entries).
         A registered block survives refcount zero as an evictable
-        cache entry instead of freeing."""
+        cache entry instead of freeing. Spans whose trie node is
+        *parked* are revived in place: the freshly prefilled block
+        becomes the node's device block and the stale tier payload is
+        dropped (the engine re-prefilled exactly because the bytes were
+        a tier away). A block previously indexed as a partial tail that
+        has since been decoded full is promoted to a full node, and a
+        tail extending an existing partial on the same block grows that
+        entry in place (turn-by-turn chat: the session's produced
+        tokens become matchable prefix for its next turn)."""
         bs = self.block_size
         node = self._root
         n_full = len(tokens) // bs
         for i in range(n_full):
             key = tokens[i * bs:(i + 1) * bs]
             child = node.children.get(key)
-            if child is None:
+            if child is None or child.tier != "device":
                 bid = block_ids[i]
-                child = _Node(key, bid, node, next(self._clock))
-                node.children[key] = child
+                prev = self._registered.get(bid)
+                if isinstance(prev, _Node):
+                    break  # block already a full node elsewhere
+                if isinstance(prev, _Partial):
+                    # decode grew the prompt's tail partial into a full
+                    # block: promote (the partial entry would otherwise
+                    # alias the same block with fewer tokens)
+                    prev.parent.partials.remove(prev)
+                    del self._registered[bid]
+                if child is None:
+                    child = _Node(key, bid, node, next(self._clock))
+                    node.children[key] = child
+                else:
+                    # parked node, freshly re-prefilled span: revive
+                    if self._tiers is not None:
+                        self._tiers.drop(child)
+                    child.block_id = bid
+                    child.tier = "device"
                 self._registered[bid] = child
             node = child
             node.stamp = next(self._clock)
         tail = tokens[n_full * bs:]
         if tail:
             bid = block_ids[n_full]
-            if bid not in self._registered and not any(
+            prev = self._registered.get(bid)
+            if (isinstance(prev, _Partial) and prev.parent is node
+                    and len(prev.tokens) < len(tail)
+                    and tail[:len(prev.tokens)] == prev.tokens):
+                prev.tokens = tail
+                prev.stamp = next(self._clock)
+            elif bid not in self._registered and not any(
                     p.tokens == tail for p in node.partials):
                 p = _Partial(tail, bid, node, next(self._clock))
                 node.partials.append(p)
@@ -348,6 +407,193 @@ class PrefixCache:
                     and self._evictable(parent.block_id, parent)):
                 heapq.heappush(heap, (parent.stamp, parent.block_id))
         return freed
+
+    # -- tiering (ROADMAP item 1) --------------------------------------------
+    def _parkable(self, bid: int, entry: Any) -> bool:
+        """Device node whose block can page out: refcount zero (shares
+        in live block tables never park), no device-tier children
+        (children park before parents — the subtree invariant), and no
+        partial entries (partials are copy-on-write donors; a reffed
+        partial pins its node, a cold one is plain-evicted first)."""
+        if not isinstance(entry, _Node) or entry.tier != "device":
+            return False
+        if self.pool.refcount(bid) != 0:
+            return False
+        if any(c.tier == "device" for c in entry.children.values()):
+            return False
+        if entry.partials:
+            return False
+        return True
+
+    def demote(self, n: int,
+               park_payload: "Callable[[int], Optional[Dict]]",
+               evict_fallback: bool = True) -> int:
+        """Free up to ``n`` device blocks by parking cold leaves into
+        the tier store (host, cascading to disk), LRU-first — the
+        tiered twin of :meth:`evict` and the single eviction policy of
+        the hierarchy: device leaves page DOWN before anything is
+        dropped, and only the disk tier's overflow discards state.
+
+        ``park_payload(bid)`` performs the D2H fetch and returns the
+        raw block payload, or ``None`` for a torn park (fault injected
+        or transfer failure) — those blocks fall back to plain eviction
+        when ``evict_fallback`` (re-prefill is always correct).
+        Refcount-0 partials interleave in the same LRU order and are
+        always plain-evicted (never parked). Returns device blocks
+        freed."""
+        import heapq
+
+        if self._tiers is None:
+            return self.evict(n)
+        heap = [(entry.stamp, bid)
+                for bid, entry in self._registered.items()
+                if (self._parkable(bid, entry)
+                    or self._evictable(bid, entry))]
+        heapq.heapify(heap)
+        freed = 0
+        while freed < n and heap:
+            stamp, bid = heapq.heappop(heap)
+            entry = self._registered.get(bid)
+            parkable = entry is not None and self._parkable(bid, entry)
+            evictable = entry is not None and self._evictable(bid, entry)
+            if not (parkable or evictable):
+                continue  # resurrected by a match since queued
+            if entry.stamp != stamp:
+                heapq.heappush(heap, (entry.stamp, bid))
+                continue
+            parent = entry.parent
+            if parkable:
+                payload = park_payload(bid)
+                if payload is not None:
+                    del self._registered[bid]
+                    entry.tier = "host"
+                    entry.block_id = -1
+                    self.pool.release([bid])
+                    self.parks += 1
+                    for lost in self._tiers.park(entry, payload):
+                        self._prune_parked(lost)
+                    freed += 1
+                elif evict_fallback and evictable:
+                    self._evict_entry(bid, entry)
+                    freed += 1
+                else:
+                    continue  # torn park, not plainly evictable: skip
+            else:
+                self._evict_entry(bid, entry)
+                freed += 1
+            # parking/evicting may expose the parent as the next
+            # candidate (its last device child / partial just left)
+            if (parent is not self._root
+                    and parent.block_id in self._registered
+                    and (self._parkable(parent.block_id, parent)
+                         or self._evictable(parent.block_id, parent))):
+                heapq.heappush(heap, (parent.stamp, parent.block_id))
+        return freed
+
+    def _evict_entry(self, bid: int, entry: Any) -> None:
+        parent = entry.parent
+        if isinstance(entry, _Partial):
+            parent.partials.remove(entry)
+        else:
+            del parent.children[entry.key]
+        del self._registered[bid]
+        self.pool.release([bid])
+        _M_EVICTIONS.inc()
+        self.evictions += 1
+
+    def _prune_parked(self, node: _Node) -> None:
+        """Remove a parked node and its (all-parked) subtree from the
+        trie and the tier store — the session re-prefills next turn."""
+        parent = node.parent
+        if parent is not None and parent.children.get(node.key) is node:
+            del parent.children[node.key]
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            if self._tiers is not None:
+                self._tiers.drop(cur)
+            stack.extend(cur.children.values())
+            cur.children.clear()
+
+    def restore_path(self, tokens: "tuple[int, ...]",
+                     alloc_block: "Callable[[], Optional[int]]",
+                     install: "Callable[[int, Dict], bool]") -> "list[int]":
+        """Page a parked prefix of ``tokens`` back onto the device
+        ahead of :meth:`match` — the turn-resume path: one H2D copy
+        per parked block instead of re-prefilling the whole prefix.
+
+        Walks the block-aligned path; device nodes pass through
+        untouched, parked nodes are fetched from their tier, given a
+        fresh pool block from ``alloc_block()`` (which may demote
+        *other* cold leaves — just-restored blocks hold a reference so
+        they can't be victims), and written back by ``install(bid,
+        payload)``. The walk stops at the first miss: allocation
+        shortfall re-parks the payload (MRU — it is about to be wanted
+        again); a corrupt payload or failed install (``kv.unpark``
+        fault) prunes that node's parked subtree so the suffix simply
+        re-prefills — the request always completes.
+
+        Returns the restored block ids, each holding one reference the
+        caller must :meth:`release` after ``match()`` takes its own."""
+        if self._tiers is None:
+            return []
+        bs = self.block_size
+        node = self._root
+        restored: "list[int]" = []
+        i = 0
+        while len(tokens) - i >= bs:
+            child = node.children.get(tokens[i:i + bs])
+            if child is None:
+                break
+            if child.tier != "device":
+                payload = self._tiers.fetch(child)
+                if payload is None:
+                    # spill lost or corrupt: drop the whole parked
+                    # subtree (all parked below a parked node)
+                    self._prune_parked(child)
+                    break
+                bid = alloc_block()
+                if bid is None:
+                    # pool shortfall: put it back at the MRU end and
+                    # let the suffix re-prefill this turn
+                    for lost in self._tiers.park(child, payload):
+                        self._prune_parked(lost)
+                    break
+                if not install(bid, payload):
+                    self.pool.release(self.pool.deref([bid]))
+                    self._prune_parked(child)
+                    break
+                child.block_id = bid
+                child.tier = "device"
+                self._registered[bid] = child
+                self.unparks += 1
+                restored.append(bid)
+            node = child
+            i += bs
+        return restored
+
+    def cold_blocks(self) -> int:
+        """Refcount-0 registered device blocks — pressure that is
+        *parkable*, not live (fabric placement wants the split)."""
+        return sum(1 for bid in self._registered
+                   if self.pool.refcount(bid) == 0)
+
+    def parked_sessions(self) -> int:
+        """Parked trie leaves — each is the tail of one idle session's
+        prefix path, the engine's proxy for resumable conversations."""
+        if self._tiers is None:
+            return 0
+        return sum(1 for node in self._tiers.nodes()
+                   if not node.children)
+
+    def tier_stats(self) -> "Optional[Dict[str, int]]":
+        if self._tiers is None:
+            return None
+        s = self._tiers.stats()
+        s["parked_sessions"] = self.parked_sessions()
+        s["parks"] = self.parks
+        s["unparks"] = self.unparks
+        return s
 
 
 def _common_prefix(a: tuple, b: tuple) -> int:
