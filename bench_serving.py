@@ -66,6 +66,16 @@ holds their prefix), `fabric_p95_ms_routed` / `fabric_p95_ms_rr`, and
 the full `fabric` block (`BENCH_FABRIC_GROUPS`=4 prefix groups,
 `BENCH_FABRIC_REQUESTS`=16 followers/round).
 
+Scaled router tier section (ISSUE 19): `BENCH_ROUTERS=N` (>=1
+enables) reruns the fleet workload behind a RouterGroup at N=1 and
+N=max(2, N) routers over one 2-host fleet, plus a wholesale-forced
+control arm at the same refresh cadence. Emits
+`router_agreement_rate` (cross-router preferred-host agreement),
+`digest_delta_bytes_per_s` vs `digest_wholesale_bytes_per_s` (plus
+the per-refresh ratio `delta_vs_wholesale_per_refresh`),
+`router_p95_ms_n1` / `router_p95_ms_n`, `hit_rate_n_vs_1`, and the
+full `router_tier` block.
+
 Sequence-parallel long-context section (ISSUE 13): the same long
 prompt (`BENCH_LONG_PROMPT_LEN`=3072) prefilled at sp=1 vs
 sp=`BENCH_SP` (default 2; <2 disables) over forced CPU devices,
@@ -711,6 +721,174 @@ def _fabric_section():
     }
 
 
+def _router_tier_section():
+    """Horizontally scaled router tier (ISSUE 19; ``BENCH_ROUTERS=N``
+    with N >= 1 enables): the shared-prefix fleet workload behind a
+    :class:`RouterGroup` of N routers over the SAME 2-host engine
+    fleet, at N=1 and N=BENCH_ROUTERS. Three measurements ride each
+    arm: client p95 + fleet prefix hit rate (the N=2 rate must stay
+    within 10 percent of single-router — deterministic placement means
+    more routers never scatter a conversation's followers), the
+    cross-router placement agreement rate (``preferred_host`` sampled
+    per follower prompt across every member — arithmetic, so ~1.0),
+    and the digest refresh wire cost: bytes/s of the delta path vs a
+    wholesale-forced arm (same fleet state, same refresh cadence,
+    deltas disabled) — steady-state delta traffic scales with CHURN,
+    wholesale with pool size x refresh rate, so the ratio is the
+    scaling headroom deltas buy."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.fabric import InProcessHost, Router, RouterGroup
+    from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+    from sparkdl_tpu.observability.registry import registry as _reg
+    from sparkdl_tpu.serving import ContinuousGPTEngine
+
+    n_routers = int(os.environ.get("BENCH_ROUTERS", "0"))
+    if n_routers < 1:
+        return None
+    n_hosts = 2
+    n_groups = int(os.environ.get("BENCH_FABRIC_GROUPS", "4"))
+    per_round = int(os.environ.get("BENCH_FABRIC_REQUESTS", "16"))
+    share = float(os.environ.get("BENCH_PREFIX_SHARE", "0.75"))
+    # longer than the fabric section's prompts: the wholesale wire
+    # cost under test scales with the CACHED state, so the workload
+    # must cache enough for the comparison to mean anything
+    plen = int(os.environ.get("BENCH_ROUTER_PROMPT_LEN", "160"))
+    refreshes_per_round = 8  # refresh cadence > churn cadence, as prod
+    max_new = 8
+    max_len = plen + max_new
+    cfg = GPTConfig(
+        vocab_size=256, hidden_size=128, num_layers=3, num_heads=4,
+        intermediate_size=256, max_seq_len=4 * max_len,
+    )
+    model = GPTLMHeadModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(3), jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(29)
+    n_shared = int(round(share * plen))
+    prefixes = [rng.integers(1, cfg.vocab_size, n_shared).tolist()
+                for _ in range(n_groups)]
+
+    def fresh_followers():
+        return [
+            prefixes[g]
+            + rng.integers(1, cfg.vocab_size, plen - n_shared).tolist()
+            for g in range(n_groups)
+            for _ in range(per_round // n_groups)
+        ]
+
+    class _WholesaleHost(InProcessHost):
+        # the control arm: no journal endpoint, every refresh re-ships
+        # the full digest (the pre-delta wire cost)
+        def prefix_digest_delta(self, since_version, max_entries=1024):
+            return None
+
+    def _bytes(name):
+        fam = _reg().snapshot().get(name) or {}
+        return float((fam.get("values") or {}).get("", 0))
+
+    def run(n, wholesale=False):
+        engines = [
+            ContinuousGPTEngine(
+                cfg, variables, n_slots=4, max_len=max_len,
+                kv_block_size=8, kv_blocks=256, idle_wait_s=0.0005,
+                host_id=f"rt-{n}{'w' if wholesale else ''}-{i}")
+            for i in range(n_hosts)
+        ]
+        wrap = _WholesaleHost if wholesale else InProcessHost
+        routers = [Router([wrap(e) for e in engines],
+                          auto_refresh=False)
+                   for _ in range(n)]
+        group = RouterGroup(routers)
+        counter = ("sparkdl_fabric_digest_wholesale_bytes_total"
+                   if wholesale else
+                   "sparkdl_fabric_digest_delta_bytes_total")
+        try:
+            for g in range(n_groups):  # compile warmup + digest seed
+                group.submit({
+                    "prompt": prefixes[g] + rng.integers(
+                        1, cfg.vocab_size, plen - n_shared).tolist(),
+                    "max_new_tokens": max_new}).result(timeout=300)
+            group.refresh()  # first post-seed sync may ride either path
+            hit_rates, p95s, agrees = [], [], []
+            bytes0 = _bytes(counter)
+            t0 = time.perf_counter()
+            for _ in range(3):
+                kv0 = [e.snapshot()["kv"] for e in engines]
+                lats, futs = [], []
+                followers = fresh_followers()
+                for i, p in enumerate(followers):
+                    t_sub = time.perf_counter()
+                    fut = group.submit(
+                        {"prompt": p, "max_new_tokens": max_new},
+                        session=f"conv-{i}")
+                    fut.add_done_callback(
+                        lambda f, t=t_sub:
+                        lats.append(time.perf_counter() - t))
+                    futs.append(fut)
+                for f in futs:
+                    f.result(timeout=300)
+                deadline = time.monotonic() + 5.0
+                while (len(lats) < len(futs)
+                       and time.monotonic() < deadline):
+                    time.sleep(0.001)
+                for _ in range(refreshes_per_round):
+                    group.refresh()
+                kv1 = [e.snapshot()["kv"] for e in engines]
+                hits = sum(b["prefix_hits"] - a["prefix_hits"]
+                           for a, b in zip(kv0, kv1))
+                miss = sum(b["prefix_misses"] - a["prefix_misses"]
+                           for a, b in zip(kv0, kv1))
+                hit_rates.append(hits / max(1, hits + miss))
+                p95s.append(float(np.percentile(lats, 95)))
+                picks = [[r.preferred_host(p) for r in routers]
+                         for p in followers]
+                agrees.append(
+                    sum(len(set(row)) == 1 for row in picks)
+                    / len(picks))
+            wall = time.perf_counter() - t0
+            wire_bytes = _bytes(counter) - bytes0
+            n_refreshes = 3 * refreshes_per_round * n * n_hosts
+        finally:
+            group.close(close_members=True)
+            for e in engines:
+                e.close()
+        return {
+            "routers": n,
+            "wholesale_forced": wholesale,
+            "prefix_hit_rate": round(float(np.median(hit_rates)), 4),
+            "p95_ms": round(1e3 * float(np.median(p95s)), 2),
+            "agreement_rate": round(float(np.min(agrees)), 4),
+            "digest_bytes_per_s": round(wire_bytes / wall, 1),
+            "digest_bytes_per_refresh": round(
+                wire_bytes / n_refreshes, 1),
+        }
+
+    single = run(1)
+    scaled = run(max(2, n_routers))
+    wholesale = run(1, wholesale=True)
+    return {
+        "hosts": n_hosts,
+        "groups": n_groups,
+        "requests_per_round": per_round,
+        "refreshes_per_round": refreshes_per_round,
+        "single": single,
+        "scaled": scaled,
+        "wholesale": wholesale,
+        "router_agreement_rate": scaled["agreement_rate"],
+        "digest_delta_bytes_per_s": scaled["digest_bytes_per_s"],
+        "digest_wholesale_bytes_per_s": wholesale[
+            "digest_bytes_per_s"],
+        "delta_vs_wholesale_per_refresh": round(
+            wholesale["digest_bytes_per_refresh"]
+            / max(1e-9, scaled["digest_bytes_per_refresh"]), 2),
+        "hit_rate_n_vs_1": round(
+            scaled["prefix_hit_rate"]
+            / max(1e-9, single["prefix_hit_rate"]), 4),
+    }
+
+
 def _autoscale_section():
     """Elastic autoscaling under stepped open-loop load (ISSUE 15;
     ``BENCH_AUTOSCALE=1`` enables): a 1-replica MLP fleet is driven
@@ -1153,6 +1331,11 @@ def main() -> None:
     # over BENCH_HOSTS in-process hosts, medians of 3.
     fabric = _fabric_section()
 
+    # Horizontally scaled router tier (ISSUE 19): RouterGroup at
+    # N=1 vs N=BENCH_ROUTERS over one fleet, delta-vs-wholesale
+    # digest wire cost, cross-router agreement (BENCH_ROUTERS>=1).
+    router_tier = _router_tier_section()
+
     # Elastic autoscaling (ISSUE 15): stepped open-loop load over an
     # AutoScaler-driven ReplicaPool (BENCH_AUTOSCALE=1 enables).
     autoscale = _autoscale_section()
@@ -1232,6 +1415,20 @@ def main() -> None:
         "fabric_p95_ms_rr": (fabric or {}).get(
             "round_robin", {}).get("p95_ms"),
         "fabric": fabric,
+        # Scaled router tier (ISSUE 19): placement agreement across
+        # routers, digest delta vs wholesale wire cost, and p95 + hit
+        # rate at N routers vs one (None when BENCH_ROUTERS<1)
+        "router_agreement_rate": (router_tier or {}).get(
+            "router_agreement_rate"),
+        "digest_delta_bytes_per_s": (router_tier or {}).get(
+            "digest_delta_bytes_per_s"),
+        "digest_wholesale_bytes_per_s": (router_tier or {}).get(
+            "digest_wholesale_bytes_per_s"),
+        "router_p95_ms_n1": (router_tier or {}).get(
+            "single", {}).get("p95_ms"),
+        "router_p95_ms_n": (router_tier or {}).get(
+            "scaled", {}).get("p95_ms"),
+        "router_tier": router_tier,
         # Elastic autoscaling (ISSUE 15): scale-event count, replica
         # trajectory, and SLO burn at burst end vs after recovery
         # (None when BENCH_AUTOSCALE != 1)

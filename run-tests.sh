@@ -326,6 +326,139 @@ print(f"fabric smoke OK: affinity hit-rate {af_rate:.2f} > "
       "zero lost + quarantine + postmortem, drain fault recovered")
 EOF
 
+# Router-tier smoke (ISSUE 19): a RouterGroup of 2 routers over one
+# 2-host fleet. An injected router.route fault tears one member's
+# placement mid-stream AND one router is hard-killed under load:
+# every accepted request still resolves oracle-exact (zero lost —
+# the group walks to the surviving member), and the steady-state
+# digest refreshes ride the DELTA wire, not wholesale.
+JAX_PLATFORMS=cpu \
+SPARKDL_TPU_FAULT_PLAN="seed=7;router.route:OSError@5" \
+python - <<'EOF'
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.fabric import InProcessHost, Router, RouterGroup
+from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel, generate
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.serving import ContinuousGPTEngine
+
+cfg = GPTConfig.tiny()
+model = GPTLMHeadModel(cfg)
+variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+engines = [ContinuousGPTEngine(
+    cfg, variables, n_slots=2, max_len=32, kv_block_size=4,
+    idle_wait_s=0.001, host_id=f"rt-{i}") for i in range(2)]
+routers = [Router([InProcessHost(e) for e in engines],
+                  auto_refresh=False) for _ in range(2)]
+group = RouterGroup(routers)
+# seed, then refresh twice: the second sync must ride the journal
+group.submit({"prompt": [7, 3, 9, 1, 5], "max_new_tokens": 2}).result(60)
+group.refresh()
+group.refresh()
+snap = registry().snapshot()
+delta_bytes = snap["sparkdl_fabric_digest_delta_bytes_total"][
+    "values"].get("", 0)
+assert delta_bytes > 0, "steady-state refresh never used the delta wire"
+# 24 requests; the fault plan tears placement #5, router 0 dies at #10
+futs = []
+for i in range(24):
+    futs.append((i, group.submit(
+        {"prompt": [1 + (i % 9), 2, 3], "max_new_tokens": 2},
+        session=f"conv-{i % 6}")))
+    if i == 10:
+        routers[0].close()   # router killed holding accepted work
+for i, f in futs:
+    got = np.asarray(f.result(60))  # zero lost: every Future resolves
+    p = [1 + (i % 9), 2, 3]
+    want = np.asarray(generate(
+        model, variables, jnp.asarray([p], jnp.int32), 2)[0, 3:])
+    np.testing.assert_array_equal(got, want)
+assert routers[0].closed and not routers[1].closed
+snap = registry().snapshot()
+inj = snap["sparkdl_faults_injected_total"]["values"]
+assert inj.get('site="router.route"', 0) >= 1, inj
+disp = snap["sparkdl_fabric_router_dispatch_total"]["values"]
+assert sum(disp.values()) >= 25, disp
+group.close(close_members=True)
+for e in engines:
+    e.close(drain=False)
+print(f"router-tier smoke OK: 24/24 oracle-exact through a torn "
+      f"placement + a router kill (dispatch {dict(disp)}), "
+      f"{delta_bytes:.0f}B of digest sync on the delta wire")
+EOF
+
+# Migration smoke (ISSUE 19): drain a host holding parked sessions ->
+# the sessions re-park on the survivor through the handoff wire codec,
+# and every turn-2 resume there (a) matches a never-migrated engine
+# bitwise and (b) beats re-prefilling the transcript cold (the
+# pre-migration cost) on wall clock.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import time
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.fabric import InProcessHost, Router
+from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.serving import ContinuousGPTEngine
+
+cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=3,
+                num_heads=4, intermediate_size=256, max_seq_len=1024)
+model = GPTLMHeadModel(cfg)
+variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+kw = dict(n_slots=2, max_len=352, kv_block_size=32, kv_blocks=24,
+          host_kv_blocks=512, disk_kv_blocks=16, idle_wait_s=0.0005)
+PLEN, NEW = 320, 8
+rng = np.random.default_rng(19)
+prompts = [rng.integers(1, cfg.vocab_size, PLEN).tolist() for _ in range(3)]
+a = ContinuousGPTEngine(cfg, variables, host_id="mig-a", **kw)
+b = ContinuousGPTEngine(cfg, variables, host_id="mig-b", **kw)
+cold = ContinuousGPTEngine(cfg, variables, host_id="mig-cold", **kw)
+
+def warm_conv(eng, park):
+    p = rng.integers(1, cfg.vocab_size, PLEN).tolist()
+    r = eng.submit(p, NEW).result(timeout=300).tolist()
+    if park is None:
+        return
+    if park:
+        eng.park_cold()
+    eng.submit(p + r + [5], NEW).result(timeout=300)
+
+warm_conv(a, None)          # compile A's prefill bucket
+warm_conv(b, True)          # compile B's resume path (install + tail)
+warm_conv(cold, False)      # compile the cold arm's full re-prefill
+replies = [a.submit(p, NEW).result(timeout=300).tolist() for p in prompts]
+a.park_cold()
+with Router([InProcessHost(a), InProcessHost(b)],
+            auto_refresh=False) as router:
+    router.drain_host("mig-a")   # exports A's parked fleet onto B
+mig = registry().snapshot()["sparkdl_kv_migrations_total"]["values"]
+assert mig.get('outcome="exported"', 0) >= 3, mig
+assert mig.get('outcome="imported"', 0) >= 3, mig
+assert b.capacity()["kv_parked_sessions"] >= 3, b.capacity()
+
+def timed(eng):
+    outs, lats = [], []
+    for p, r in zip(prompts, replies):
+        t0 = time.perf_counter()
+        outs.append(eng.submit(p + r + [5], NEW)
+                    .result(timeout=300).tolist())
+        lats.append(time.perf_counter() - t0)
+    return outs, 1e3 * float(np.median(lats))
+
+out_b, resume_p50 = timed(b)       # migrated resume: unpark + tail
+out_cold, reprefill_p50 = timed(cold)  # never saw the transcripts
+assert out_b == out_cold, "migrated resume diverged from cold oracle"
+assert b._kv_snapshot()["tiers"]["unparks"] > 0, "resume re-prefilled"
+assert resume_p50 < reprefill_p50, (resume_p50, reprefill_p50)
+for e in (a, b, cold):
+    e.close(drain=False)
+print(f"migration smoke OK: 3 parked sessions drained mig-a -> mig-b "
+      f"over the wire codec; resume p50 {resume_p50:.1f}ms beats cold "
+      f"re-prefill {reprefill_p50:.1f}ms, tokens bitwise")
+EOF
+
 # Elastic-autoscale smoke (ISSUE 15): a 1-replica pool + engine under
 # manual controller ticks. (a) load step -> scale-up within a bounded
 # tick count; (b) load drop -> drain-based scale-down with ZERO lost
@@ -579,8 +712,12 @@ EOF
 # BENCH_PARK_DEPTH: the tiered-KV section must show turn-2 resume
 # beating re-prefill at both depths with >=4x device-only sessions
 # parked per chip.
+# BENCH_ROUTERS=2: the scaled-router-tier section must show N=2
+# placement agreement ~1, digest deltas >=10x smaller than wholesale
+# per refresh, and the N=2 hit rate within 10% of single-router.
 JAX_PLATFORMS=cpu BENCH_REQUESTS=64 BENCH_SPEC_K=4 BENCH_KV_DTYPE=int8 \
   BENCH_AUTOSCALE=1 BENCH_DISAGG=1 BENCH_PARK_DEPTH=8,16 \
+  BENCH_ROUTERS=2 \
   python bench_serving.py | tail -1 | python -c '
 import json, os, sys
 rec = json.loads(sys.stdin.readline())
@@ -724,8 +861,26 @@ assert rec["parked_sessions_per_chip"] >= \
 assert "sparkdl_kv_tier_blocks" in obs, sorted(obs)
 assert "sparkdl_kv_parks_total" in obs, sorted(obs)
 assert "sparkdl_kv_unparks_total" in obs, sorted(obs)
+# ISSUE 19: scaled router tier — cross-router placement agreement is
+# arithmetic (~1.0), steady-state digest deltas move >=10x fewer
+# bytes per refresh than the wholesale-forced control at the same
+# cadence, N=2 prefix hit rate stays within 10% of single-router,
+# p95 measured at both N, and the new families ride the spine
+rt = rec["router_tier"]
+assert rec["router_agreement_rate"] >= 0.99, rt
+assert rec["digest_delta_bytes_per_s"] > 0, rt
+assert rec["digest_wholesale_bytes_per_s"] > 0, rt
+assert rt["delta_vs_wholesale_per_refresh"] >= 10.0, rt
+assert rt["hit_rate_n_vs_1"] >= 0.9, rt
+assert rec["router_p95_ms_n1"] > 0, rt
+assert rec["router_p95_ms_n"] > 0, rt
+assert rt["scaled"]["routers"] >= 2, rt
+assert "sparkdl_fabric_digest_delta_bytes_total" in obs, sorted(obs)
+assert "sparkdl_fabric_digest_delta_applied_total" in obs, sorted(obs)
+assert "sparkdl_fabric_router_dispatch_total" in obs, sorted(obs)
 print("bench_serving contract OK (snapshot + slo + flight + kv + spec "
-      "+ sp + fabric + autoscale + disagg + phases + park embedded)")
+      "+ sp + fabric + autoscale + disagg + phases + park + router "
+      "tier embedded)")
 '
 
 # Paged-KV smoke (ISSUE 10): (a) a shared-prefix workload through the

@@ -25,13 +25,31 @@ Three layers, separately testable:
   hotspot one host), sticky sessions, spillover admission control,
   probation circuit-breaking with postmortem bundles on quarantine,
   host-level failover, and graceful :meth:`Router.drain_host` whose
-  unstarted requests transfer queue-to-queue onto surviving hosts.
+  unstarted requests transfer queue-to-queue onto surviving hosts (and
+  whose parked sessions migrate to survivors, ISSUE 19).
+- :mod:`~sparkdl_tpu.fabric.group` — the horizontally scaled router
+  tier (ISSUE 19): N stateless routers agreeing on placement through
+  rendezvous hashing (:func:`~sparkdl_tpu.fabric.digest.hrw_score`)
+  instead of shared state, fronted by :class:`RouterGroup`
+  (in-process) or :class:`RouterServer`/:class:`RouterHandle` (HTTP),
+  with digest DELTAS keeping per-router refresh traffic ≤KBs/sec.
 """
 
 from sparkdl_tpu.fabric.digest import (
     HostDigest,
+    hrw_preferred_host,
+    hrw_score,
     match_blocks,
+    path_anchor,
+    placement_key,
     prompt_block_hashes,
+    session_key,
+)
+from sparkdl_tpu.fabric.group import (
+    AllRoutersUnavailableError,
+    RouterGroup,
+    RouterHandle,
+    RouterServer,
 )
 from sparkdl_tpu.fabric.host import (
     HOST_LEVEL_ERRORS,
@@ -45,6 +63,7 @@ from sparkdl_tpu.fabric.router import AllHostsUnavailableError, Router
 
 __all__ = [
     "AllHostsUnavailableError",
+    "AllRoutersUnavailableError",
     "HOST_LEVEL_ERRORS",
     "HostDigest",
     "HostDrainingError",
@@ -54,6 +73,14 @@ __all__ = [
     "HttpHostHandle",
     "InProcessHost",
     "Router",
+    "RouterGroup",
+    "RouterHandle",
+    "RouterServer",
+    "hrw_preferred_host",
+    "hrw_score",
     "match_blocks",
+    "path_anchor",
+    "placement_key",
     "prompt_block_hashes",
+    "session_key",
 ]

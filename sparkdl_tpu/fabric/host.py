@@ -94,6 +94,29 @@ class HostHandle:
     def prefix_digest(self, max_entries: int = 1024) -> "dict | None":
         raise NotImplementedError
 
+    def prefix_digest_delta(self, since_version: int,
+                            max_entries: int = 1024) -> "dict | None":
+        """Journal of block-hash adds/removes since ``since_version``
+        (ISSUE 19), or None when the host cannot produce one (no
+        journal, gap, dense layout) — the router then re-syncs with one
+        wholesale :meth:`prefix_digest`. Defaulting to None keeps every
+        pre-delta handle (and test fake) correct: they simply stay on
+        the wholesale path."""
+        return None
+
+    def export_parked_sessions(self) -> "dict | None":
+        """Serialize this host's parked sessions for migration
+        (ISSUE 19); None when the host has nothing to export or no
+        tier store. Default None: migration quietly no-ops on hosts
+        that cannot ship state, and those sessions re-prefill."""
+        return None
+
+    def import_parked_sessions(self, bundle: "dict | None") -> int:
+        """Adopt migrated parked sessions; returns sessions adopted.
+        Default 0: a host that cannot import simply lets the sessions
+        re-prefill — the pre-migration cost, never an error."""
+        return 0
+
     def trace(self, request_id: int) -> "dict[str, Any]":
         """This host's span fragments for one trace (ISSUE 17):
         ``{"host_id", "now_us", "spans"}``. ``now_us`` is the host's
@@ -186,6 +209,20 @@ class InProcessHost(HostHandle):
     def prefix_digest(self, max_entries: int = 1024) -> "dict | None":
         fn = getattr(self.engine, "prefix_digest", None)
         return fn(max_entries) if callable(fn) else None
+
+    def prefix_digest_delta(self, since_version: int,
+                            max_entries: int = 1024) -> "dict | None":
+        fn = getattr(self.engine, "prefix_digest_delta", None)
+        return (fn(since_version, max_entries) if callable(fn)
+                else None)
+
+    def export_parked_sessions(self) -> "dict | None":
+        fn = getattr(self.engine, "export_parked_sessions", None)
+        return fn() if callable(fn) else None
+
+    def import_parked_sessions(self, bundle: "dict | None") -> int:
+        fn = getattr(self.engine, "import_parked_sessions", None)
+        return int(fn(bundle)) if callable(fn) else 0
 
     def trace(self, request_id: int) -> "dict[str, Any]":
         from sparkdl_tpu.observability import tracing

@@ -25,6 +25,15 @@ Server endpoints (:class:`HostServer`, wrapping one engine):
 * ``GET /fabric/snapshot`` → ``engine.snapshot()`` (host_id + capacity
   included — the router's weighting input).
 * ``GET /fabric/digest`` → ``engine.prefix_digest()`` (null for dense).
+* ``GET /fabric/digest_delta?since=N`` → ``{"delta": ...}`` — the
+  block-hash journal since version N (ISSUE 19), null when the host
+  cannot produce one (gap, dense, no journal): the router re-syncs
+  with one wholesale ``/fabric/digest``.
+* ``POST /fabric/migrate_out`` → ``{"bundle": ...}`` (the host's
+  parked sessions, serialized through the handoff raw-storage codec)
+  and ``POST /fabric/migrate_in`` ``{"bundle": ...}`` →
+  ``{"imported": n}`` — the two wire ends of parked-session migration
+  on drain/scale-down (ISSUE 19).
 * ``GET /fabric/trace?request_id=N`` → this host's span fragments for
   one trace plus its trace-clock reading (``now_us``) — the
   :class:`~sparkdl_tpu.observability.fleet.FleetScraper`'s stitching
@@ -134,6 +143,13 @@ class _FabricHandler(BaseHTTPRequestHandler):
                 n = int(params.get("max_entries", ["1024"])[0])
                 dig = owner.engine.prefix_digest(n)
                 self._reply(200, {"digest": dig})
+            elif path == "/fabric/digest_delta":
+                params = urllib.parse.parse_qs(query)
+                since = int(params.get("since", ["0"])[0])
+                n = int(params.get("max_entries", ["1024"])[0])
+                fn = getattr(owner.engine, "prefix_digest_delta", None)
+                delta = fn(since, n) if callable(fn) else None
+                self._reply(200, {"delta": delta})
             elif path == "/fabric/trace":
                 params = urllib.parse.parse_qs(query)
                 rid = int(params.get("request_id", ["0"])[0])
@@ -174,6 +190,17 @@ class _FabricHandler(BaseHTTPRequestHandler):
                 self._reply(200, owner.handle_submit(body))
             elif path == "/fabric/drain":
                 self._reply(200, owner.handle_drain())
+            elif path == "/fabric/migrate_out":
+                fn = getattr(owner.engine, "export_parked_sessions",
+                             None)
+                bundle = fn() if callable(fn) else None
+                self._reply(200, {"bundle": bundle})
+            elif path == "/fabric/migrate_in":
+                fn = getattr(owner.engine, "import_parked_sessions",
+                             None)
+                n = (int(fn(body.get("bundle")))
+                     if callable(fn) else 0)
+                self._reply(200, {"imported": n})
             else:
                 self.send_error(404)
         except Exception as e:
@@ -431,6 +458,27 @@ class HttpHostHandle(HostHandle):
         return self._get(
             f"/fabric/digest?max_entries={int(max_entries)}"
         ).get("digest")
+
+    def prefix_digest_delta(self, since_version: int,
+                            max_entries: int = 1024) -> "dict | None":
+        return self._get(
+            f"/fabric/digest_delta?since={int(since_version)}"
+            f"&max_entries={int(max_entries)}"
+        ).get("delta")
+
+    def export_parked_sessions(self) -> "dict | None":
+        # migration can ship many blocks: give it the result budget,
+        # not the bare connect timeout
+        return self._request(
+            "/fabric/migrate_out", {},
+            timeout_s=self.result_timeout_s).get("bundle")
+
+    def import_parked_sessions(self, bundle: "dict | None") -> int:
+        if not bundle:
+            return 0
+        return int(self._request(
+            "/fabric/migrate_in", {"bundle": bundle},
+            timeout_s=self.result_timeout_s).get("imported") or 0)
 
     def trace(self, request_id: int) -> "dict[str, Any]":
         out = self._get(f"/fabric/trace?request_id={int(request_id)}")

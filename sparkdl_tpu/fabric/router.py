@@ -59,6 +59,7 @@ Fault sites: ``router.route`` (every placement decision),
 from __future__ import annotations
 
 import collections
+import json
 import threading
 import time
 from concurrent.futures import CancelledError, Future, InvalidStateError
@@ -73,8 +74,13 @@ from sparkdl_tpu.serving.queue import QueueFullError, Request
 
 from sparkdl_tpu.fabric.digest import (
     HostDigest,
+    hrw_preferred_host,
+    hrw_score,
     match_blocks,
+    path_anchor,
+    placement_key,
     prompt_block_hashes,
+    session_key,
 )
 from sparkdl_tpu.fabric.host import (
     HOST_LEVEL_ERRORS,
@@ -112,6 +118,22 @@ _M_DIGEST_BLOCKS = registry().gauge(
     "sparkdl_fabric_digest_blocks",
     "prefix-digest entries the router holds per host",
     labels=("host",))
+_M_DELTA_BYTES = registry().counter(
+    "sparkdl_fabric_digest_delta_bytes_total",
+    "wire bytes of digest DELTA payloads the router consumed (the "
+    "steady-state refresh cost; compare sparkdl_fabric_digest_"
+    "wholesale_bytes_total)")
+_M_WHOLESALE_BYTES = registry().counter(
+    "sparkdl_fabric_digest_wholesale_bytes_total",
+    "wire bytes of WHOLESALE digest snapshots the router pulled "
+    "(first contact, delta gaps, and hosts without a journal)")
+_M_DELTA_APPLIED = registry().counter(
+    "sparkdl_fabric_digest_delta_applied_total",
+    "digest delta consumption outcomes (applied: folded in; replayed: "
+    "stale duplicate skipped idempotently; gap: journal rolled past "
+    "this router, wholesale re-sync; error: torn delta fetch, "
+    "wholesale re-sync)",
+    labels=("outcome",))
 
 
 class AllHostsUnavailableError(RuntimeError):
@@ -223,7 +245,8 @@ class Router:
                  max_outstanding: "int | None" = None,
                  session_capacity: int = 4096,
                  refresh_interval_s: float = 2.0,
-                 auto_refresh: bool = True):
+                 auto_refresh: bool = True,
+                 placement_block_size: "int | None" = None):
         if policy not in ("affinity", "round_robin", "headroom"):
             raise ValueError(
                 f"policy must be 'affinity', 'round_robin', or "
@@ -238,7 +261,16 @@ class Router:
         if probation_s is not None and probation_s <= 0:
             raise ValueError(
                 f"probation_s must be > 0 or None, got {probation_s}")
+        if placement_block_size is not None and placement_block_size < 1:
+            raise ValueError(
+                f"placement_block_size must be >= 1 or None, got "
+                f"{placement_block_size}")
         self.policy = policy
+        #: block grid the rendezvous placement key hashes under; None
+        #: derives it from the fleet's published digests (min block
+        #: size). Pin it when routers must agree before any digest
+        #: arrives (cross-process determinism).
+        self.placement_block_size = placement_block_size
         self.affinity_weight = affinity_weight
         self.load_weight = load_weight
         self.affinity_cap_blocks = affinity_cap_blocks
@@ -357,7 +389,8 @@ class Router:
         # hash outside the lock (pure CPU work); one digest grid per
         # distinct block size in the fleet (normally exactly one)
         hashes_by_bs: "dict[int, list[int]]" = {}
-        if prompt is not None:
+        pkey: "int | None" = None
+        if prompt is not None and len(prompt):
             with self._lock:
                 sizes = {s.digest.block_size
                          for s in self._hosts.values()
@@ -366,6 +399,9 @@ class Router:
                 bs: prompt_block_hashes(prompt, bs,
                                         self.affinity_cap_blocks)
                 for bs in sizes}
+            pbs = (self.placement_block_size
+                   or (min(sizes) if sizes else 16))
+            pkey = placement_key(prompt, pbs)
         spilled = False
         affine = False
         probe = False
@@ -380,10 +416,11 @@ class Router:
                      or (not transfer and s.breaker.probe_due(now)))
             ]
             if candidates:
-                chosen = self._sticky_locked(rec, candidates)
+                chosen = self._sticky_locked(rec, candidates,
+                                             hashes_by_bs)
                 if chosen is None:
                     chosen, spilled, affine = self._score_locked(
-                        rec, candidates, hashes_by_bs,
+                        rec, candidates, hashes_by_bs, pkey,
                         include_saturated=transfer)
                 if chosen.quarantined:
                     chosen.breaker.begin_probe()
@@ -414,27 +451,54 @@ class Router:
         return chosen
 
     def _sticky_locked(self, rec: _Placement,
-                       candidates: "list[_HostState]"
+                       candidates: "list[_HostState]",
+                       hashes_by_bs: "dict[int, list[int]]"
                        ) -> "_HostState | None":
-        """A continuing session lands on its remembered host when that
-        host is still eligible AND has room — its cache holds the
-        session's history, the strongest affinity signal there is.
-        First placements and broken stickiness fall through to
-        scoring."""
+        """Place a continuing session on the host that holds its
+        history. Three steps, strongest evidence first (ISSUE 19 — the
+        per-router LRU alone silently dropped affinity under churn and
+        never survived a router restart):
+
+        1. the LRU remembers a still-eligible host with room — the
+           fast path, same as always;
+        2. no LRU entry, but some host's DIGEST matches the prompt —
+           real cache evidence (this router restarted, or another
+           router placed the session, or the session migrated): fall
+           through to scoring, which follows the match;
+        3. neither — rendezvous-hash the session id over the open
+           candidates, so every router (and every restart of this one)
+           derives the same home without sharing the LRU.
+        First placements with no session and failover re-routes fall
+        through to scoring."""
         if rec.session is None or rec.attempts:
             return None
         host_id = self._sessions.get(rec.session)
-        if host_id is None:
+        if host_id is not None:
+            for s in candidates:
+                if (s.host_id == host_id and not s.quarantined
+                        and s.outstanding < s.saturation):
+                    return s
             return None
-        for s in candidates:
-            if (s.host_id == host_id and not s.quarantined
-                    and s.outstanding < s.saturation):
-                return s
-        return None
+        if hashes_by_bs:
+            for s in candidates:
+                if s.digest is None:
+                    continue
+                hashes = hashes_by_bs.get(s.digest.block_size)
+                if hashes and match_blocks(hashes, s.digest):
+                    return None  # cache evidence beats the hash
+        open_hosts = [s for s in candidates if not s.quarantined
+                      and s.outstanding < s.saturation]
+        if not open_hosts:
+            return None
+        skey = session_key(rec.session)
+        best = max(open_hosts,
+                   key=lambda s: (hrw_score(skey, s.host_id), s.host_id))
+        return best
 
     def _score_locked(self, rec: _Placement,
                       candidates: "list[_HostState]",
                       hashes_by_bs: "dict[int, list[int]]",
+                      pkey: "int | None" = None,
                       include_saturated: bool = False
                       ) -> "tuple[_HostState, bool, bool]":
         """(chosen, spilled, affine): affinity-bonus minus load-penalty
@@ -442,7 +506,12 @@ class Router:
         saturated host would have scored best (spillover admission
         control diverted the request). ``include_saturated`` (drain
         transfers) scores every candidate — already-accepted traffic is
-        never re-rejected."""
+        never re-rejected. ``pkey`` (the prompt's rendezvous placement
+        key) breaks score TIES deterministically so N routers with the
+        same view agree — it never outvotes load or affinity, which is
+        the whole disagreement-window story: routers whose views differ
+        disagree only inside the tie set, costing at most one cold
+        prefill, never correctness."""
         def bonus(s: _HostState) -> int:
             if not hashes_by_bs or s.digest is None:
                 return 0
@@ -506,8 +575,14 @@ class Router:
             for s in candidates}
         best_score = max(scores[s.host_id] for s in open_hosts)
         ties = [s for s in open_hosts if scores[s.host_id] == best_score]
-        chosen = ties[self._rr % len(ties)]
-        self._rr += 1
+        if pkey is not None:
+            # rendezvous tie-break: every router resolves the same tie
+            # the same way, with no shared state (ISSUE 19)
+            chosen = max(ties, key=lambda s: (hrw_score(pkey, s.host_id),
+                                              s.host_id))
+        else:
+            chosen = ties[self._rr % len(ties)]
+            self._rr += 1
         # spillover: a saturated host would have outscored the choice
         spilled = max(scores.values()) > best_score
         return chosen, spilled, bonuses[chosen.host_id] > 0
@@ -631,11 +706,46 @@ class Router:
         for state in list(self._hosts.values()):
             self._refresh_host(state)
 
+    def _refresh_digest(self, state: _HostState) -> "HostDigest | None":
+        """Advance one host's digest, delta-first (ISSUE 19): ask the
+        host for the journal since the version we hold and fold it in —
+        KBs/sec regardless of pool size — falling back to ONE wholesale
+        snapshot on first contact, journal gaps, torn fetches
+        (``digest.delta`` fault), or hosts that publish no journal
+        (``prefix_digest_delta`` → None). Host-level errors propagate:
+        the caller's unreachable-marking is about the HOST, not the
+        refresh mode."""
+        prev = state.digest
+        if prev is not None:
+            delta = None
+            try:
+                delta = state.handle.prefix_digest_delta(
+                    prev.version, max_entries=self.digest_entries)
+            except HOST_LEVEL_ERRORS:
+                raise
+            except Exception:
+                # torn delta fetch: the journal said nothing usable —
+                # re-sync wholesale below, same as a gap
+                _M_DELTA_APPLIED.inc(outcome="error")
+            else:
+                if delta is not None:
+                    advanced = prev.apply_delta(delta)
+                    if advanced is not None:
+                        _M_DELTA_BYTES.inc(len(json.dumps(delta)))
+                        _M_DELTA_APPLIED.inc(
+                            outcome=("applied" if advanced is not prev
+                                     else "replayed"))
+                        return advanced
+                    _M_DELTA_APPLIED.inc(outcome="gap")
+        snap = state.handle.prefix_digest(self.digest_entries)
+        if snap is not None:
+            _M_WHOLESALE_BYTES.inc(len(json.dumps(snap)))
+        return HostDigest.from_snapshot(snap)
+
     def _refresh_host(self, state: _HostState) -> None:
         try:
             cap = state.handle.capacity()
-            digest = HostDigest.from_snapshot(
-                state.handle.prefix_digest(self.digest_entries))
+            digest = self._refresh_digest(state)
             health = state.handle.health()
         except Exception as e:
             with self._lock:
@@ -688,16 +798,20 @@ class Router:
 
     # -- drain / lifecycle ---------------------------------------------------
     def drain_host(self, host_id: str, *,
-                   wait_s: "float | None" = None) -> int:
+                   wait_s: "float | None" = None,
+                   migrate_parked: bool = True) -> int:
         """Gracefully drain one host for a rolling restart: no new
         placements, unstarted requests re-queued onto surviving hosts
         (queue-level :class:`Request` transfer for in-process hosts —
         trace ids/deadlines/Futures intact; transport hosts fail their
         blocked submits with :class:`HostDrainingError` and the
         failover path re-places them), in-flight requests finish where
-        they are. Returns the number of requests re-queued. ``wait_s``
-        blocks (bounded) until the router sees zero outstanding work on
-        the host."""
+        they are, and — unless ``migrate_parked=False`` — the host's
+        PARKED sessions re-park on survivors chosen by the fleet-agreed
+        rendezvous hash (ISSUE 19), so idle conversations resume with a
+        page-in instead of a cold re-prefill. Returns the number of
+        requests re-queued. ``wait_s`` blocks (bounded) until the
+        router sees zero outstanding work on the host."""
         state = self._hosts.get(host_id)
         if state is None:
             raise KeyError(f"unknown fabric host {host_id!r}")
@@ -725,6 +839,8 @@ class Router:
         moved = self._requeue_requests(reqs)
         flight.record_event(
             "fabric.drain_requeued", host=host_id, requeued=moved)
+        if migrate_parked:
+            self._migrate_parked(state)
         if wait_s is not None:
             deadline = time.monotonic() + wait_s
             while time.monotonic() < deadline:
@@ -732,6 +848,54 @@ class Router:
                     if state.outstanding <= 0:
                         break
                 time.sleep(0.01)
+        return moved
+
+    def _migrate_parked(self, state: _HostState) -> int:
+        """Move a draining host's parked sessions onto survivors, each
+        to the host the fleet-agreed rendezvous hash of its path anchor
+        picks — the SAME key a next-turn prompt extending that session
+        hashes to, so stickiness re-derives without any router having
+        to remember the move. Best-effort by design: any session a torn
+        export/import drops simply re-prefills on resume (exactly the
+        pre-migration cost), never fails a request. Returns sessions
+        successfully adopted by survivors."""
+        try:
+            bundle = state.handle.export_parked_sessions()
+        except Exception as e:
+            flight.record_event(
+                "fabric.migrate_export_failed", host=state.host_id,
+                error=type(e).__name__)
+            return 0
+        if not bundle or not bundle.get("sessions"):
+            return 0
+        bs = int(bundle.get("block_size") or 0)
+        with self._lock:
+            survivors = sorted(
+                hid for hid, s in self._hosts.items()
+                if s is not state and not s.draining)
+        if not survivors or bs < 1:
+            return 0
+        per_target: "dict[str, list]" = {}
+        for sess in bundle["sessions"]:
+            target = hrw_preferred_host(
+                path_anchor(sess["tokens"], bs), survivors)
+            per_target.setdefault(target, []).append(sess)
+        moved = 0
+        for hid, sessions in per_target.items():
+            tstate = self._hosts.get(hid)
+            if tstate is None:
+                continue
+            sub = dict(bundle)
+            sub["sessions"] = sessions
+            try:
+                moved += int(tstate.handle.import_parked_sessions(sub))
+            except Exception as e:
+                flight.record_event(
+                    "fabric.migrate_import_failed", host=hid,
+                    error=type(e).__name__)
+        flight.record_event(
+            "fabric.migrate", host=state.host_id, sessions=moved,
+            targets=sorted(per_target))
         return moved
 
     def requeue(self, reqs: "list[Request]") -> int:
@@ -911,6 +1075,27 @@ class Router:
 
     def hosts(self) -> "list[str]":
         return list(self._hosts)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def preferred_host(self, prompt) -> "str | None":
+        """PURE fleet-agreed placement for ``prompt`` — the rendezvous
+        max over ALL member host ids, ignoring load/health/digests.
+        Every router over the same host set returns the same answer in
+        any process (the cross-process determinism contract); live
+        placement only diverges from it to follow load, affinity, or
+        failures. None for an empty prompt."""
+        if prompt is None or not len(prompt):
+            return None
+        with self._lock:
+            host_ids = sorted(self._hosts)
+            sizes = {s.digest.block_size
+                     for s in self._hosts.values()
+                     if s.digest is not None}
+        pbs = self.placement_block_size or (min(sizes) if sizes else 16)
+        return hrw_preferred_host(placement_key(prompt, pbs), host_ids)
 
     def host_handles(self) -> "list[HostHandle]":
         """Live handles (ISSUE 16): tier-level aggregations — e.g. the

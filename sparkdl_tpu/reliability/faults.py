@@ -88,6 +88,8 @@ KNOWN_SITES = (
     "worker.rank",
     "kv.park",
     "kv.unpark",
+    "digest.delta",
+    "kv.migrate",
 )
 
 _M_INJECTED = None

@@ -387,7 +387,6 @@ class ContinuousGPTEngine:
         #: snapshot()/capacity are keyed by it, the prefix digest names
         #: it, and SPARKDL_TPU_HOST_ID pins it per process
         self.host_id = host_id if host_id is not None else default_host_id()
-        self._digest_seq = 0
         self.n_slots = n_slots
         self.max_len = max_len
         self.eos_id = eos_id
@@ -1188,14 +1187,39 @@ class ContinuousGPTEngine:
         if self.kv_layout != "paged":
             return None
         with self._lock:
-            hashes = self._prefix.block_hashes(max_entries)
-            self._digest_seq += 1
+            # version is the prefix cache's membership-mutation counter
+            # (ISSUE 19), NOT a per-publish sequence: two wholesale
+            # fetches with no traffic between them carry the same
+            # version, and a delta whose ``since`` matches it replays
+            # exactly the mutations this snapshot missed.
             return {
                 "host_id": self.host_id,
                 "block_size": self._kv_bs,
-                "version": self._digest_seq,
-                "hashes": hashes,
+                "version": self._prefix.digest_version,
+                "hashes": self._prefix.block_hashes(max_entries),
             }
+
+    def prefix_digest_delta(self, since_version: int,
+                            max_entries: int = 1024) -> "dict | None":
+        """Membership adds/evictions since ``since_version`` — the
+        steady-state digest refresh (ISSUE 19): a router tracking this
+        host pulls KBs of deltas instead of re-shipping the wholesale
+        digest every interval. ``None`` = gap (the caller fell behind
+        the bounded journal, or claims a future version): refresh
+        wholesale. The ``digest.delta`` fault site models a torn delta
+        read — the router answers any error here the same way, with a
+        wholesale re-sync."""
+        if self.kv_layout != "paged":
+            return None
+        with self._lock:
+            fault_point("digest.delta")
+            delta = self._prefix.block_hash_delta(
+                int(since_version), max_entries)
+            if delta is None:
+                return None
+            delta["host_id"] = self.host_id
+            delta["block_size"] = self._kv_bs
+            return delta
 
     def _loop(self) -> None:
         try:
@@ -1570,6 +1594,7 @@ class ContinuousGPTEngine:
             self._pool_kv = self._unpark_install_fn(
                 self._pool_kv, jnp.asarray([bid], jnp.int32), tree)
         except Exception as e:
+            # sparkdl-lint: disable=lock-discipline -- same reach as the install above: restore_path's caller (_admit_paged) already holds self._lock
             self._park_fallbacks += 1
             kv_tiers_mod._M_FALLBACKS.inc(op="unpark")
             flight_mod.record_event(
@@ -1605,6 +1630,126 @@ class ContinuousGPTEngine:
                 n, self._park_payload, evict_fallback=False)
             self._update_unpark_reserved()
             return freed
+
+    # -- parked-session migration (ISSUE 19) ----------------------------------
+    def export_parked_sessions(self,
+                               max_sessions: "int | None" = None
+                               ) -> "dict | None":
+        """Serialize every parked session's block-aligned prefix path
+        for re-parking on another host — the drain/scale-down tail of
+        ROADMAP item 1: without this, parked state strands on the host
+        that parked it and every idle conversation re-prefills cold.
+        Each session ships its WHOLE path (device-resident ancestors
+        are D2H-fetched like a park; parked blocks are peeked from
+        their tier) through the handoff raw-storage codec, so the
+        importing host resumes bitwise-identically. Exported parked
+        subtrees are pruned here — the state now lives on the target;
+        a torn export (``kv.migrate`` fault) skips that session, which
+        simply re-prefills on resume (never lost, never duplicated).
+        None when this engine has no tier store."""
+        if self.kv_layout != "paged" or self._kv_tiers is None:
+            return None
+        from sparkdl_tpu.disagg.handoff import _enc
+        from sparkdl_tpu.serving import kv_tiers as kv_tiers_mod
+
+        t0 = time.monotonic()
+        sessions: "list[dict]" = []
+        with self._lock:
+            paths = self._prefix.parked_leaf_paths()
+            if max_sessions is not None:
+                paths = paths[:int(max_sessions)]
+            prune: "list[Any]" = []
+            for tokens, nodes in paths:
+                try:
+                    fault_point("kv.migrate")
+                    blocks = []
+                    for n in nodes:
+                        pl = (self._park_payload(n.block_id)
+                              if n.tier == "device"
+                              else self._kv_tiers.peek(n))
+                        if pl is None:
+                            raise RuntimeError(
+                                "torn export: block payload unavailable")
+                        blocks.append(
+                            {k: _enc(np.asarray(v))
+                             for k, v in pl.items()})
+                except Exception as e:
+                    kv_tiers_mod._M_MIGRATIONS.inc(outcome="export_failed")
+                    flight_mod.record_event(
+                        "kv.migrate_export_failed", host=self.host_id,
+                        error=type(e).__name__)
+                    continue
+                sessions.append({"tokens": [int(t) for t in tokens],
+                                 "blocks": blocks})
+                kv_tiers_mod._M_MIGRATIONS.inc(outcome="exported")
+                kv_tiers_mod._M_MIG_BLOCKS.inc(len(blocks))
+                top = next(
+                    (n for n in nodes if n.tier != "device"), None)
+                if top is not None:
+                    prune.append(top)
+            seen: "set[int]" = set()
+            for top in prune:
+                # tops are roots of maximal parked subtrees — disjoint,
+                # but two leaves under one top share it: prune once
+                if id(top) in seen:
+                    continue
+                seen.add(id(top))
+                self._prefix._prune_parked(top)
+            self._update_unpark_reserved()
+        kv_tiers_mod._M_MIG_SEC.observe(time.monotonic() - t0)
+        flight_mod.record_event(
+            "kv.migrate_export", host=self.host_id,
+            sessions=len(sessions))
+        return {"host_id": self.host_id, "block_size": self._kv_bs,
+                "kv_dtype": self.kv_dtype, "sessions": sessions}
+
+    def import_parked_sessions(self, bundle: "dict | None") -> int:
+        """Adopt migrated parked sessions into this host's tier store
+        (the receiving end of :meth:`export_parked_sessions`): each
+        session's blocks re-park here and its trie path is grafted in,
+        so the next turn's ``restore_path`` pages it in with one H2D
+        per block instead of a re-prefill. Sessions on a different
+        block grid or storage dtype are skipped whole (their bytes
+        cannot install here — re-prefill is the correct fallback), as
+        is any session torn by the ``kv.migrate`` fault site. Returns
+        sessions adopted."""
+        if (self.kv_layout != "paged" or self._kv_tiers is None
+                or not bundle):
+            return 0
+        from sparkdl_tpu.disagg.handoff import _dec
+        from sparkdl_tpu.serving import kv_tiers as kv_tiers_mod
+
+        if int(bundle.get("block_size") or 0) != self._kv_bs:
+            return 0
+        dtype = bundle.get("kv_dtype")
+        if dtype is not None and str(dtype) != str(self.kv_dtype):
+            return 0
+        t0 = time.monotonic()
+        adopted = 0
+        with self._lock:
+            for sess in bundle.get("sessions") or ():
+                try:
+                    fault_point("kv.migrate")
+                    blocks = [{k: _dec(v) for k, v in b.items()}
+                              for b in sess["blocks"]]
+                    toks = tuple(int(t) for t in sess["tokens"])
+                    if len(toks) != len(blocks) * self._kv_bs:
+                        raise ValueError("ragged migration payload")
+                    self._prefix.adopt_parked(toks, blocks)
+                except Exception as e:
+                    kv_tiers_mod._M_MIGRATIONS.inc(
+                        outcome="import_failed")
+                    flight_mod.record_event(
+                        "kv.migrate_import_failed", host=self.host_id,
+                        error=type(e).__name__)
+                    continue
+                adopted += 1
+                kv_tiers_mod._M_MIGRATIONS.inc(outcome="imported")
+            self._update_unpark_reserved()
+        kv_tiers_mod._M_MIG_SEC.observe(time.monotonic() - t0)
+        flight_mod.record_event(
+            "kv.migrate_import", host=self.host_id, sessions=adopted)
+        return adopted
 
     def _prefill_tick(self) -> None:
         """Advance chunked prefills by at most ``prefill_chunk`` REAL

@@ -62,6 +62,19 @@ _M_FALLBACKS = registry().counter(
     "tiering operations abandoned for the plain path (op=park: torn "
     "page-out, blocks evicted instead; op=unpark: corrupt page-in, "
     "session re-prefills)", labels=("op",))
+_M_MIGRATIONS = registry().counter(
+    "sparkdl_kv_migrations_total",
+    "parked sessions migrated between hosts on drain/scale-down "
+    "(outcome=exported/imported: the two wire ends; export_failed/"
+    "import_failed: torn migration, the session re-prefills instead)",
+    labels=("outcome",))
+_M_MIG_BLOCKS = registry().counter(
+    "sparkdl_kv_migration_blocks_total",
+    "KV blocks serialized onto the wire by parked-session migration")
+_M_MIG_SEC = registry().histogram(
+    "sparkdl_kv_migration_seconds",
+    "wall seconds per parked-session migration call (one host's export "
+    "or import batch)")
 _M_PARK_SEC = registry().histogram(
     "sparkdl_kv_park_seconds",
     "wall seconds per park operation (D2H fetch + host insert, one "
@@ -209,6 +222,24 @@ class TieredKVStore:
             if payload is not None:
                 _M_UNPARKS.inc(tier="disk")
             return payload
+        return None
+
+    def peek(self, node: Hashable) -> Optional[Dict]:
+        """Read ``node``'s payload WITHOUT removing it from its tier —
+        the migration-export read (ISSUE 19): the bytes go onto the
+        wire while the local entry stays authoritative until the
+        importing host confirms. No LRU touch, no unpark accounting
+        (the block is not coming back to the device here). ``None``
+        when not resident or the spill file fails to load."""
+        payload = self._host.get(node)
+        if payload is not None:
+            return payload
+        path = self._disk.get(node)
+        if path is not None:
+            try:
+                return self._load(path)
+            except Exception:
+                return None
         return None
 
     def drop(self, node: Hashable) -> None:

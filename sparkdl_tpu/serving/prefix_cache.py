@@ -49,10 +49,11 @@ vs prefilled, ``sparkdl_prefix_evictions_total`` counts blocks evicted.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import hashlib
 import itertools
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from sparkdl_tpu.observability.registry import registry
 from sparkdl_tpu.serving.kv_blocks import KVBlockPool
@@ -106,7 +107,7 @@ class _Node:
     the root-to-node path spells the whole prefix."""
 
     __slots__ = ("key", "block_id", "parent", "children", "partials",
-                 "stamp", "tier")
+                 "stamp", "tier", "digest_hash")
 
     def __init__(self, key, block_id, parent, stamp):
         self.key = key
@@ -118,6 +119,11 @@ class _Node:
         #: "device" | "host" | "disk" — parked nodes keep their trie
         #: position but hold no pool block (block_id is invalid)
         self.tier = "device"
+        #: this prefix's chained digest entry, fixed at creation (the
+        #: path never changes while the node exists) — what the digest
+        #: journal publishes and block_hashes() reads back
+        self.digest_hash = (DIGEST_ROOT if parent is None
+                            else chain_hash(parent.digest_hash, key))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -139,7 +145,8 @@ class PrefixCache:
     """Token-trie prefix index over a :class:`KVBlockPool`."""
 
     def __init__(self, pool: KVBlockPool,
-                 tiers: "Optional[TieredKVStore]" = None):
+                 tiers: "Optional[TieredKVStore]" = None,
+                 journal_limit: int = 1024):
         self.pool = pool
         self.block_size = pool.block_size
         self._clock = itertools.count(1)
@@ -149,6 +156,17 @@ class PrefixCache:
         self._registered: "dict[int, Any]" = {}
         #: host/disk tiers for parked nodes (None = flat single-tier)
         self._tiers = tiers
+        #: monotonic digest-membership version: bumps once per trie node
+        #: added or removed (parking/unparking moves bytes, not
+        #: membership, so it does NOT bump). Routers key deltas on it.
+        self.digest_version = 0
+        #: bounded (version, op, hash) journal of membership mutations —
+        #: ``block_hash_delta`` replays the suffix past a router's
+        #: version; a router older than the journal's tail gets a gap
+        #: (None) and refreshes wholesale. Bounded to the digest cap:
+        #: a delta bigger than the digest itself has no reason to exist.
+        self._journal: "collections.deque[Tuple[int, str, int]]" = (
+            collections.deque(maxlen=max(1, int(journal_limit))))
         # engine-visible counters (the registry families are process
         # totals; benches/snapshots want this engine's share)
         self.hit_tokens = 0
@@ -267,17 +285,62 @@ class PrefixCache:
         if max_entries < 1:
             return []
         entries: "list[tuple[int, int]]" = []
-        stack: "list[tuple[_Node, int]]" = [
-            (child, chain_hash(DIGEST_ROOT, key))
-            for key, child in self._root.children.items()
-        ]
+        stack: "list[_Node]" = list(self._root.children.values())
         while stack:
-            node, h = stack.pop()
-            entries.append((node.stamp, h))
-            for key, child in node.children.items():
-                stack.append((child, chain_hash(h, key)))
+            node = stack.pop()
+            entries.append((node.stamp, node.digest_hash))
+            stack.extend(node.children.values())
         entries.sort(reverse=True)
         return [h for _, h in entries[:max_entries]]
+
+    # -- digest deltas (ISSUE 19) --------------------------------------------
+    def _journal_mutation(self, op: str, node: _Node) -> None:
+        self.digest_version += 1
+        self._journal.append((self.digest_version, op, node.digest_hash))
+
+    def block_hash_delta(self, since_version: int,
+                         max_entries: int = 1024) -> "Optional[Dict]":
+        """Membership mutations since ``since_version``, coalesced into
+        ``added``/``removed`` hash lists — what a router applies on top
+        of its last wholesale :meth:`block_hashes` snapshot instead of
+        re-shipping the whole digest every refresh (ISSUE 19).
+
+        Returns ``None`` for a **gap**: the journal no longer covers
+        ``(since_version, digest_version]`` (the caller fell too far
+        behind its bounded tail), the caller claims a future version
+        (restarted host), or the coalesced delta would exceed
+        ``max_entries`` (wholesale is cheaper at that point). The
+        caller answers a gap with a wholesale refresh — always correct,
+        never required for correctness (digests are advisory).
+        Call under the engine lock, like every other trie walk."""
+        since = int(since_version)
+        if since > self.digest_version:
+            return None  # a future version: the host restarted
+        delta = {"since": since, "version": self.digest_version,
+                 "added": [], "removed": []}
+        if since == self.digest_version:
+            return delta  # caught up: the steady-state no-op
+        if not self._journal or self._journal[0][0] > since + 1:
+            return None  # journal tail truncated past the caller
+        added: "set[int]" = set()
+        removed: "set[int]" = set()
+        for ver, op, h in self._journal:
+            if ver <= since:
+                continue
+            if op == "+":
+                removed.discard(h)
+                added.add(h)
+            else:
+                # an add that never reached the caller nets to nothing
+                if h in added:
+                    added.discard(h)
+                else:
+                    removed.add(h)
+        if len(added) + len(removed) > max_entries:
+            return None
+        delta["added"] = sorted(added)
+        delta["removed"] = sorted(removed)
+        return delta
 
     def record_lookup(self, hit_tokens: int, miss_tokens: int) -> None:
         """Land one admission's hit/miss split (prompt tokens) in the
@@ -325,6 +388,7 @@ class PrefixCache:
                 if child is None:
                     child = _Node(key, bid, node, next(self._clock))
                     node.children[key] = child
+                    self._journal_mutation("+", child)
                 else:
                     # parked node, freshly re-prefilled span: revive
                     if self._tiers is not None:
@@ -392,14 +456,7 @@ class PrefixCache:
                 heapq.heappush(heap, (entry.stamp, bid))
                 continue
             parent = entry.parent
-            if isinstance(entry, _Partial):
-                parent.partials.remove(entry)
-            else:
-                del parent.children[entry.key]
-            del self._registered[bid]
-            self.pool.release([bid])
-            _M_EVICTIONS.inc()
-            self.evictions += 1
+            self._evict_entry(bid, entry)
             freed += 1
             # the eviction may have exposed its parent as a new leaf
             if (parent is not self._root
@@ -496,6 +553,7 @@ class PrefixCache:
             parent.partials.remove(entry)
         else:
             del parent.children[entry.key]
+            self._journal_mutation("-", entry)
         del self._registered[bid]
         self.pool.release([bid])
         _M_EVICTIONS.inc()
@@ -512,6 +570,7 @@ class PrefixCache:
             cur = stack.pop()
             if self._tiers is not None:
                 self._tiers.drop(cur)
+            self._journal_mutation("-", cur)
             stack.extend(cur.children.values())
             cur.children.clear()
 
@@ -571,6 +630,72 @@ class PrefixCache:
             node = child
             i += bs
         return restored
+
+    def parked_leaf_paths(self) -> "List[Tuple[tuple, List[_Node]]]":
+        """``(tokens, root→leaf node path)`` for every parked leaf —
+        one entry per resumable idle session, the export side of
+        parked-session migration (ISSUE 19). The path may start with
+        device-resident ancestors (a session that parked only its
+        tail); the caller serializes those too so the importing host
+        can adopt the WHOLE prefix. Call under the engine lock."""
+        if self._tiers is None:
+            return []
+        out: "List[Tuple[tuple, List[_Node]]]" = []
+        for leaf in list(self._tiers.nodes()):
+            if leaf.children:
+                continue
+            path: "List[_Node]" = []
+            cur = leaf
+            while cur is not None and cur is not self._root:
+                if (cur.parent is None
+                        or cur.parent.children.get(cur.key) is not cur):
+                    break  # orphaned by a racing prune: skip the leaf
+                path.append(cur)
+                cur = cur.parent
+            if cur is not self._root:
+                continue
+            path.reverse()
+            tokens = tuple(t for n in path for t in n.key)
+            out.append((tokens, path))
+        return out
+
+    def adopt_parked(self, tokens: "tuple[int, ...]",
+                     payloads: "List[Dict]") -> int:
+        """Graft a migrated session's block-aligned prefix into this
+        trie as PARKED nodes (ISSUE 19): ``payloads[i]`` holds the raw
+        storage bytes for tokens ``[i*bs, (i+1)*bs)``. Spans this trie
+        already holds (device or parked) keep their existing state —
+        the resident bytes are identical by construction (KV is a pure
+        function of the prefix). Returns blocks newly parked. The next
+        turn's :meth:`restore_path` pages the path back in exactly as
+        if it had parked here — one H2D per block, no re-prefill."""
+        if self._tiers is None:
+            raise RuntimeError(
+                "adopt_parked needs a tier store (host_kv_blocks)")
+        bs = self.block_size
+        node = self._root
+        adopted = 0
+        for i, payload in enumerate(payloads):
+            key = tuple(tokens[i * bs:(i + 1) * bs])
+            if len(key) < bs:
+                break  # ragged tail: the digest grid is block-aligned
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key, -1, node, next(self._clock))
+                child.tier = "host"
+                node.children[key] = child
+                self._journal_mutation("+", child)
+                self.parks += 1
+                for lost in self._tiers.park(child, payload):
+                    self._prune_parked(lost)
+                if self._tiers.tier_of(child) is None:
+                    # the park cascade dropped the adopted node itself
+                    # (tiers full of protected entries): the rest of
+                    # the path would dangle unreachable — stop here
+                    break
+                adopted += 1
+            node = child
+        return adopted
 
     def cold_blocks(self) -> int:
         """Refcount-0 registered device blocks — pressure that is
