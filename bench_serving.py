@@ -1190,6 +1190,157 @@ def _disagg_section():
     }
 
 
+def _tenancy_section():
+    """Multi-tenant QoS isolation (ISSUE 20; ``BENCH_TENANTS>=3``
+    enables): one flooding tenant offered ~10x its admission quota
+    against ``BENCH_TENANTS - 1`` compliant tenants on a shared
+    engine, solo (no flooder) vs storm. Per-batch service time is a
+    fixed HOST-side sleep (a plain ``run_batch`` object — inside a
+    jitted apply_fn the sleep would trace away) so the victims'
+    latency is dominated by a DETERMINISTIC term: the isolation ratio
+    then measures scheduling, not scheduler jitter, and the batch is
+    sized so victims + the flooder's quota-capped residue never
+    overflow it. Emits the worst victim p95 storm/solo ratio
+    (the 1.10x acceptance bar), the flooder's shed share (overage
+    rejected typed at the door), and a driven brownout episode's level
+    trajectory (up the ladder under synthetic burn, background sheds
+    counted per level, recovery back to 0)."""
+    n_tenants = int(os.environ.get("BENCH_TENANTS", "0"))
+    if n_tenants < 3:
+        return None
+    import threading
+
+    from sparkdl_tpu.serving import (
+        PRIORITY_BACKGROUND,
+        BrownoutShedError,
+        OverloadController,
+        RequestQueue,
+        ServingEngine,
+        TenantRegistry,
+        TenantThrottledError,
+    )
+    from sparkdl_tpu.serving.tenancy import set_process_overload
+
+    victims = [f"tenant-{i}" for i in range(n_tenants - 1)]
+    n_per_victim = int(os.environ.get("BENCH_TENANT_REQUESTS", "48"))
+    service_s = 0.025
+    flood_rate = 40.0
+    row = np.ones((2,), np.float32)
+
+    class _FixedServiceRunner:
+        chunk_size = 16
+
+        def run_batch(self, arrays):
+            time.sleep(service_s)
+            return arrays["x"] * 2.0 + 1.0
+
+    def _run(flood):
+        reg = TenantRegistry(latency_threshold_s=0.25, window_s=60.0)
+        reg.configure("flood", rate=flood_rate, burst=2)
+        runner = _FixedServiceRunner()
+        lats = {t: [] for t in victims}
+        shed, flood_futs, offered = [0], [], [0]
+        stop = threading.Event()
+        with ServingEngine(runner, max_wait_s=0.03,
+                           max_queue_depth=1024, tenants=reg) as eng:
+            def flooder():
+                give_up = time.monotonic() + 60.0
+                while (not stop.is_set()
+                       and time.monotonic() < give_up):
+                    offered[0] += 1
+                    try:
+                        flood_futs.append(
+                            eng.submit({"x": row}, tenant="flood"))
+                    except TenantThrottledError:
+                        shed[0] += 1
+                    time.sleep(0.001)
+
+            th = threading.Thread(target=flooder, daemon=True)
+            if flood:
+                th.start()
+            futs = []
+            try:
+                for _ in range(n_per_victim):
+                    for tenant in victims:
+                        t0 = time.perf_counter()
+                        f = eng.submit({"x": row}, tenant=tenant)
+                        f.add_done_callback(
+                            lambda f, t=tenant, s=t0: lats[t].append(
+                                time.perf_counter() - s))
+                        futs.append(f)
+                    time.sleep(0.01)
+                for f in futs:
+                    f.result(timeout=60)
+            finally:
+                stop.set()
+                if flood:
+                    th.join(timeout=10)
+            for f in flood_futs:
+                f.result(timeout=60)  # zero accepted lost
+            deadline = time.monotonic() + 10.0
+            while (any(len(lats[t]) < n_per_victim for t in victims)
+                   and time.monotonic() < deadline):
+                time.sleep(0.001)
+        report = reg.slo_report()
+        return {
+            "p95_ms": {t: round(1e3 * float(np.percentile(lats[t], 95)),
+                                2) for t in victims},
+            "compliance": {
+                t: report[t]["latency"]["compliance"] for t in victims},
+            "flooder": {
+                "offered": offered[0],
+                "admitted": len(flood_futs),
+                "shed": shed[0],
+            },
+        }
+
+    solo = _run(flood=False)
+    storm = _run(flood=True)
+    fl = storm["flooder"]
+    isolation = max(storm["p95_ms"][t] / solo["p95_ms"][t]
+                    for t in victims)
+
+    # driven brownout episode: synthetic burn walks the ladder up and
+    # back while a controller-guarded queue sheds background submits
+    reg = TenantRegistry()
+    ctrl = OverloadController(hysteresis=1, recovery_ticks=1,
+                              cooldown_ticks=0)
+    prev = set_process_overload(ctrl)
+    levels, sheds_per_level = [], {}
+    try:
+        q = RequestQueue(max_depth=64, tenants=reg)
+        for _ in range(4):
+            levels.append(ctrl.evaluate(burn_rate=10.0))
+            try:
+                q.submit("bg", tenant="batch",
+                         priority=PRIORITY_BACKGROUND)
+            except BrownoutShedError as e:
+                sheds_per_level[str(e.level)] = (
+                    sheds_per_level.get(str(e.level), 0) + 1)
+        for _ in range(4):
+            levels.append(
+                ctrl.evaluate(burn_rate=0.0, queue_frac=0.0))
+        q.close()
+    finally:
+        set_process_overload(prev)
+
+    return {
+        "tenants": n_tenants,
+        "requests_per_victim": n_per_victim,
+        "service_s": service_s,
+        "flood_quota_per_s": flood_rate,
+        "solo": solo,
+        "storm": storm,
+        "tenant_isolation_ratio": round(isolation, 4),
+        "compliance_ratio": round(min(
+            (storm["compliance"][t] or 1.0)
+            / (solo["compliance"][t] or 1.0) for t in victims), 4),
+        "shed_share": round(fl["shed"] / max(1, fl["offered"]), 4),
+        "brownout_levels": levels,
+        "brownout_sheds_per_level": sheds_per_level,
+    }
+
+
 def main() -> None:
     n_replicas = int(os.environ.get("BENCH_REPLICAS", "1"))
     n_sp = int(os.environ.get("BENCH_SP", "2"))
@@ -1350,6 +1501,11 @@ def main() -> None:
     # disables).
     park = _gpt_park_section()
 
+    # Multi-tenant QoS (ISSUE 20): hot-tenant storm vs solo baseline,
+    # flooder shed share, and a driven brownout episode
+    # (BENCH_TENANTS>=3 enables).
+    tenancy = _tenancy_section()
+
     gap = calibrate_dispatch_gap()
     n_dispatches = dispatch_count("serving")
     snap_wall = registry().snapshot().get(
@@ -1465,6 +1621,15 @@ def main() -> None:
             (park or {}).get("depths") or [{}])[-1].get(
                 "parked_sessions_per_chip"),
         "park": park,
+        # Multi-tenant QoS (ISSUE 20): worst victim p95 storm/solo
+        # ratio (the 1.10x isolation bar), the flooder's shed share,
+        # and the brownout episode's level trajectory (None when
+        # BENCH_TENANTS<3)
+        "tenant_isolation_ratio": (tenancy or {}).get(
+            "tenant_isolation_ratio"),
+        "shed_share": (tenancy or {}).get("shed_share"),
+        "brownout_levels": (tenancy or {}).get("brownout_levels"),
+        "tenancy": tenancy,
         # SLO accounting + flight recorder (ISSUE 9): declared objective
         # with rolling burn, and the event-ring volume this run produced
         "slo": replica_snap.get("slo"),

@@ -715,9 +715,13 @@ EOF
 # BENCH_ROUTERS=2: the scaled-router-tier section must show N=2
 # placement agreement ~1, digest deltas >=10x smaller than wholesale
 # per refresh, and the N=2 hit rate within 10% of single-router.
+# BENCH_TENANTS=3: the multi-tenant QoS section must show the worst
+# victim p95 within 10% of its flooder-free baseline while the
+# flooder's ~10x overage sheds typed, and a driven brownout episode
+# walking the ladder up and back to 0.
 JAX_PLATFORMS=cpu BENCH_REQUESTS=64 BENCH_SPEC_K=4 BENCH_KV_DTYPE=int8 \
   BENCH_AUTOSCALE=1 BENCH_DISAGG=1 BENCH_PARK_DEPTH=8,16 \
-  BENCH_ROUTERS=2 \
+  BENCH_ROUTERS=2 BENCH_TENANTS=3 \
   python bench_serving.py | tail -1 | python -c '
 import json, os, sys
 rec = json.loads(sys.stdin.readline())
@@ -878,9 +882,32 @@ assert rt["scaled"]["routers"] >= 2, rt
 assert "sparkdl_fabric_digest_delta_bytes_total" in obs, sorted(obs)
 assert "sparkdl_fabric_digest_delta_applied_total" in obs, sorted(obs)
 assert "sparkdl_fabric_router_dispatch_total" in obs, sorted(obs)
+# ISSUE 20: multi-tenant QoS — the worst victim p95 must stay within
+# 10% of its flooder-free baseline (and compliance within 10%) while
+# the flooder is offered >=3x what its quota admits and its overage
+# sheds typed at the door; the driven brownout episode must step the
+# ladder to at least shed_background (shedding background submits at
+# every raised level) and recover to 0; tenant + overload metric
+# families live on the spine
+tn = rec["tenancy"]
+assert rec["tenant_isolation_ratio"] <= 1.10, tn
+assert tn["compliance_ratio"] >= 0.90, tn
+fl = tn["storm"]["flooder"]
+assert fl["offered"] >= 3 * max(1, fl["admitted"]), fl
+assert fl["shed"] > 0, fl
+assert 0 < rec["shed_share"] < 1, rec["shed_share"]
+assert max(rec["brownout_levels"]) >= 1, rec["brownout_levels"]
+assert rec["brownout_levels"][-1] == 0, rec["brownout_levels"]
+assert sum(tn["brownout_sheds_per_level"].values()) >= 1, tn
+assert "sparkdl_tenant_admitted_total" in obs, sorted(obs)
+assert "sparkdl_tenant_shed_total" in obs, sorted(obs)
+assert "sparkdl_tenant_latency_seconds" in obs, sorted(obs)
+assert "sparkdl_overload_level" in obs, sorted(obs)
+assert "sparkdl_overload_transitions_total" in obs, sorted(obs)
+assert "sparkdl_overload_shed_total" in obs, sorted(obs)
 print("bench_serving contract OK (snapshot + slo + flight + kv + spec "
       "+ sp + fabric + autoscale + disagg + phases + park + router "
-      "tier embedded)")
+      "tier + tenancy embedded)")
 '
 
 # Paged-KV smoke (ISSUE 10): (a) a shared-prefix workload through the
@@ -1095,6 +1122,109 @@ print(f"tiered-KV park smoke OK: {tiers['parks']} parks / "
       f"{tiers['unparks']} unparks bitwise across 8 sessions on a "
       f"10-block device pool; {fb} torn parks fell back to eviction "
       "with zero lost requests")
+EOF
+
+# Multi-tenant QoS smoke (ISSUE 20): one engine under (a) a flooding
+# tenant offered ~10x its admission quota — the overage sheds TYPED at
+# the door (TenantThrottledError, never a timeout) while every accepted
+# request completes; (b) an env-plan tenant.preempt fault on the first
+# preemption attempt — the victim still re-queues (zero lost, tokens
+# bitwise) and the SECOND attempt preempts clean; (c) a driven brownout
+# ladder — level up under synthetic burn (healthz degraded, background
+# shed), then recovery back to level 0 with healthz ok.
+JAX_PLATFORMS=cpu \
+SPARKDL_TPU_FAULT_PLAN="tenant.preempt:RuntimeError@1" python - <<'EOF'
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel, generate
+from sparkdl_tpu.observability.flight import flight_recorder, healthz_report
+from sparkdl_tpu.serving import ContinuousGPTEngine
+from sparkdl_tpu.serving.tenancy import (
+    PRIORITY_BACKGROUND, BrownoutShedError, OverloadController,
+    TenantRegistry, TenantThrottledError, set_process_overload)
+
+cfg = GPTConfig.tiny()
+model = GPTLMHeadModel(cfg)
+variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+reg = TenantRegistry(latency_threshold_s=5.0)
+reg.configure("offline", priority=PRIORITY_BACKGROUND)
+reg.configure("flood", rate=5.0, burst=2)
+eng = ContinuousGPTEngine(
+    cfg, variables, n_slots=1, max_len=32, auto_start=False,
+    kv_block_size=4, prefill_chunk=4, tenants=reg)
+rng = np.random.default_rng(20)
+
+def oracle(p, n):
+    return np.asarray(generate(
+        model, variables, jnp.asarray([p], jnp.int32), n)[0, len(p):])
+
+def drain(futs):
+    for _ in range(2000):
+        eng.tick()
+        if all(f.done() for f in futs):
+            return
+    raise SystemExit("engine never drained")
+
+# (b) two preemption rounds: the env plan tears attempt #1 (victim
+# re-queues anyway), attempt #2 preempts clean
+base = flight_recorder().events_total
+for _ in range(2):
+    bg = rng.integers(1, cfg.vocab_size, 12).tolist()
+    fg = rng.integers(1, cfg.vocab_size, 6).tolist()
+    f_bg = eng.submit(bg, 4, tenant="offline")
+    eng.tick()  # first chunk only: mid-prefill, the sole slot held
+    f_fg = eng.submit(fg, 4, tenant="acme")
+    drain([f_bg, f_fg])  # zero lost, both bitwise
+    np.testing.assert_array_equal(f_fg.result(timeout=0), oracle(fg, 4))
+    np.testing.assert_array_equal(f_bg.result(timeout=0), oracle(bg, 4))
+kinds = [e["kind"] for e in flight_recorder().events()
+         if e["seq"] > base and e["kind"].startswith("tenant.")]
+assert "tenant.preempt_failed" in kinds, kinds   # round 1: torn
+assert "tenant.preempted" in kinds, kinds        # round 2: clean
+
+# (a) flooder storm: 40 offered against a burst-2 bucket; overage shed
+# typed, every ACCEPTED request still completes with real tokens
+p = rng.integers(1, cfg.vocab_size, 4).tolist()
+accepted, shed = [], 0
+for _ in range(40):
+    try:
+        accepted.append(eng.submit(p, 2, tenant="flood"))
+    except TenantThrottledError:
+        shed += 1
+assert shed >= 30, f"flooder only shed {shed}/40"
+drain(accepted)
+for f in accepted:
+    np.testing.assert_array_equal(f.result(timeout=0), oracle(p, 2))
+snap = reg.snapshot()["flood"]
+assert snap["shed"] == shed and snap["admitted"] == len(accepted), snap
+
+# (c) brownout ladder: hot ticks step it up (healthz degraded,
+# background shed at admission), quiet ticks walk it back to 0
+ctrl = OverloadController(hysteresis=1, recovery_ticks=1,
+                          cooldown_ticks=0)
+prev = set_process_overload(ctrl)
+try:
+    ctrl.evaluate(burn_rate=10.0)
+    assert ctrl.level >= 1
+    assert healthz_report()["status"] == "degraded", healthz_report()
+    try:
+        eng.submit(p, 2, tenant="offline")
+        raise SystemExit("brownout never shed the background submit")
+    except BrownoutShedError as e:
+        assert e.level == ctrl.level
+    f_ok = eng.submit(p, 2, tenant="acme")  # interactive still admitted
+    drain([f_ok])
+    ctrl.evaluate(burn_rate=0.0, queue_frac=0.0)
+    assert ctrl.level == 0
+    assert healthz_report()["status"] == "ok", healthz_report()
+finally:
+    set_process_overload(prev)
+eng.close()
+print(f"tenant QoS smoke OK: torn preempt re-queued + clean preempt "
+      f"(bitwise both rounds), flooder shed {shed}/40 typed with "
+      f"{len(accepted)} accepted all exact, brownout stepped to "
+      f"level>=1 (healthz degraded, background shed) and recovered")
 EOF
 
 # Fault-injection smoke (ISSUE 5): resumable_finetune survives an

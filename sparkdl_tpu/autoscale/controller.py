@@ -384,6 +384,17 @@ class AutoScaler:
         try:
             # 5) replica/fleet tier: direction vote with hysteresis
             direction = self._vote(queue_depth, burn)
+            from sparkdl_tpu.serving import tenancy
+
+            if direction < 0 and tenancy.overload_level() > 0:
+                # brownout veto (ISSUE 20): the process is above normal
+                # on the overload ladder — shrinking capacity now would
+                # deepen the very overload the ladder is shedding. A
+                # down-vote simply does not count until level 0.
+                direction = 0
+                flight.record_event(
+                    "autoscale.overload_vetoed_down",
+                    level=tenancy.overload_level())
             key = "up" if direction > 0 else "down"
             if direction == 0 or key in self._tabu:
                 self._streak = 0

@@ -20,6 +20,7 @@ import threading
 from typing import Any, Callable, Iterable, Iterator
 
 from sparkdl_tpu.observability import flight
+from sparkdl_tpu.serving import tenancy
 
 __all__ = ["BatchPrefillFiller"]
 
@@ -35,10 +36,19 @@ class BatchPrefillFiller:
 
     def __init__(self, phase_router, source: "Iterable[tuple]", *,
                  max_inflight: int = 2, interval_s: float = 0.02,
-                 on_result: "Callable[[Any], None] | None" = None):
+                 on_result: "Callable[[Any], None] | None" = None,
+                 tenant: str = "offline",
+                 priority: int = tenancy.PRIORITY_BACKGROUND):
         if max_inflight < 1:
             raise ValueError(
                 f"max_inflight must be >= 1, got {max_inflight}")
+        #: offline work rides the LOWEST priority class on the shared
+        #: per-tenant scheduler (ISSUE 20): an interactive arrival is
+        #: always served first, and may preempt an offline prefill
+        #: between chunks — the filler's own stand-down checks are now
+        #: the polite fast path, not the only protection
+        self.tenant = tenant
+        self.priority = int(priority)
         self.phase_router = phase_router
         self._source: Iterator = iter(source)
         self.max_inflight = max_inflight
@@ -68,6 +78,8 @@ class BatchPrefillFiller:
                     return n
                 if self._source_dry and self._pending is None:
                     return n
+            if tenancy.overload_level() >= tenancy.LEVEL_SHED_BACKGROUND:
+                return n  # brownout: offline load is the first shed
             if self.phase_router.tier_depths()["prefill"] > 0:
                 return n  # live prompts queued: stand down
             item = self._next_item()
@@ -75,7 +87,9 @@ class BatchPrefillFiller:
                 return n
             prompt, max_new = item
             try:
-                fut = self.phase_router.submit(prompt, max_new)
+                fut = self.phase_router.submit(
+                    prompt, max_new,
+                    tenant=self.tenant, priority=self.priority)
             except Exception:
                 # tier refused (closing/overloaded): hold the item and
                 # retry on a later pump — the source is not consumed

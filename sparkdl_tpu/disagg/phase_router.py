@@ -93,10 +93,16 @@ class PhaseRouter:
     # -- submission -----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int, *,
                timeout_s: "float | None" = None,
-               session: "str | None" = None) -> Future:
+               session: "str | None" = None,
+               tenant: str = "default",
+               priority: "int | None" = None) -> Future:
         """One Future of the generated ids (first token included) —
         indistinguishable from a colocated engine's ``submit``, except
-        the prompt prefilled on one tier and decodes on another."""
+        the prompt prefilled on one tier and decodes on another.
+        ``tenant``/``priority`` ride the payload onto the prefill
+        tier's per-tenant scheduler (ISSUE 20) and survive a mid-
+        handoff requeue — a background victim re-enters its own class,
+        never ahead of interactive work."""
         if self._closed:
             raise RuntimeError("PhaseRouter is closed")
         deadline = (time.monotonic() + timeout_s
@@ -107,7 +113,8 @@ class PhaseRouter:
             self.submitted += 1
         self._start_prefill(prompt_ids, max_new_tokens, caller,
                             deadline, session,
-                            self.max_handoff_retries)
+                            self.max_handoff_retries,
+                            tenant, priority)
         return caller
 
     @staticmethod
@@ -119,19 +126,24 @@ class PhaseRouter:
         return max(1e-3, deadline - time.monotonic())
 
     def _start_prefill(self, prompt, max_new, caller, deadline,
-                       session, retries_left) -> None:
+                       session, retries_left,
+                       tenant: str = "default",
+                       priority: "int | None" = None) -> None:
         try:
             fut = self.prefill.submit(
-                {"prompt": prompt, "max_new_tokens": max_new},
+                {"prompt": prompt, "max_new_tokens": max_new,
+                 "tenant": tenant, "priority": priority},
                 timeout_s=self._remaining(deadline), session=session)
         except Exception as e:
             self._finish(caller, exc=e)
             return
         fut.add_done_callback(lambda f: self._on_prefill_done(
-            f, caller, deadline, session, retries_left))
+            f, caller, deadline, session, retries_left,
+            tenant, priority))
 
     def _on_prefill_done(self, f: Future, caller, deadline, session,
-                         retries_left) -> None:
+                         retries_left, tenant: str = "default",
+                         priority: "int | None" = None) -> None:
         try:
             handoff = f.result()
         except BaseException as e:
@@ -140,30 +152,34 @@ class PhaseRouter:
             self._finish(caller, exc=e)
             return
         self._start_decode(handoff, caller, deadline, session,
-                           retries_left)
+                           retries_left, tenant, priority)
 
     def _start_decode(self, h: KVHandoff, caller, deadline, session,
-                      retries_left) -> None:
+                      retries_left, tenant: str = "default",
+                      priority: "int | None" = None) -> None:
         try:
             fut = self.decode.submit(
                 {"handoff": h}, timeout_s=self._remaining(deadline))
         except Exception as e:
             self._lost_mid_handoff(e, h, caller, deadline, session,
-                                   retries_left)
+                                   retries_left, tenant, priority)
             return
         fut.add_done_callback(lambda f: self._on_decode_done(
-            f, h, caller, deadline, session, retries_left))
+            f, h, caller, deadline, session, retries_left,
+            tenant, priority))
 
     def _on_decode_done(self, f: Future, h, caller, deadline, session,
-                        retries_left) -> None:
+                        retries_left, tenant: str = "default",
+                        priority: "int | None" = None) -> None:
         try:
             self._finish(caller, result=f.result())
         except BaseException as e:
             self._lost_mid_handoff(e, h, caller, deadline, session,
-                                   retries_left)
+                                   retries_left, tenant, priority)
 
     def _lost_mid_handoff(self, exc, h, caller, deadline, session,
-                          retries_left) -> None:
+                          retries_left, tenant: str = "default",
+                          priority: "int | None" = None) -> None:
         """The handoff died between tiers. Retryable losses re-enter at
         the prefill tier's queue HEAD; anything else is the request's
         own outcome."""
@@ -172,10 +188,12 @@ class PhaseRouter:
             self._finish(caller, exc=exc)
             return
         self._requeue_at_prefill(exc, h, caller, deadline, session,
-                                 retries_left - 1)
+                                 retries_left - 1, tenant, priority)
 
     def _requeue_at_prefill(self, exc, h: KVHandoff, caller, deadline,
-                            session, retries_left) -> None:
+                            session, retries_left,
+                            tenant: str = "default",
+                            priority: "int | None" = None) -> None:
         """The zero-loss crossing: rebuild the victim as an
         already-accepted :class:`Request` — request id, trace context,
         original enqueue stamp, and absolute deadline all preserved —
@@ -197,6 +215,8 @@ class PhaseRouter:
         inner: Future = Future()
         inner.request_id = h.request_id
         inner.set_running_or_notify_cancel()
+        from sparkdl_tpu.serving import tenancy
+
         req = Request(
             GenRequest(np.asarray(h.prompt, np.int32),
                        int(h.max_new_tokens)),
@@ -205,9 +225,13 @@ class PhaseRouter:
             h.enqueued if h.enqueued else time.monotonic(),
             trace_ctx=h.trace_ctx,
             request_id=int(h.request_id),
-            started=True)
+            started=True,
+            tenant=tenant,
+            priority=(priority if priority is not None
+                      else tenancy.PRIORITY_INTERACTIVE))
         inner.add_done_callback(lambda f: self._on_prefill_done(
-            f, caller, deadline, session, retries_left))
+            f, caller, deadline, session, retries_left,
+            tenant, priority))
         try:
             self.prefill.requeue([req])
         except Exception as e:

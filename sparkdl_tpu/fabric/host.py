@@ -170,9 +170,14 @@ class InProcessHost(HostHandle):
             return self.engine.submit_handoff(
                 payload["handoff"], timeout_s=timeout_s)
         if self._gpt:
+            # tenant/priority ride the payload only when the submitter
+            # set them (ISSUE 20): an absent key leaves the engine's
+            # defaults untouched — the bitwise single-user path
+            extra = {k: payload[k] for k in ("tenant", "priority")
+                     if payload.get(k) is not None}
             return self.engine.submit(
                 payload["prompt"], payload["max_new_tokens"],
-                timeout_s=timeout_s)
+                timeout_s=timeout_s, **extra)
         return self.engine.submit(payload, timeout_s=timeout_s)
 
     def snapshot(self) -> "dict[str, Any]":

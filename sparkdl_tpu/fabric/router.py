@@ -165,7 +165,8 @@ class _HostState:
     __slots__ = ("handle", "host_id", "outstanding", "routed",
                  "breaker", "draining", "health_status", "digest",
                  "weight", "saturation", "free_slots", "kv_free",
-                 "kv_total", "kv_cold", "kv_parked_sessions")
+                 "kv_total", "kv_cold", "kv_parked_sessions",
+                 "overload_level")
 
     def __init__(self, handle: HostHandle, saturation: "int | None",
                  breaker: ProbationBreaker):
@@ -192,6 +193,10 @@ class _HostState:
         #: parked in the host/disk tiers — pressure that is NOT "full"
         self.kv_cold: "int | None" = None
         self.kv_parked_sessions: "int | None" = None
+        #: brownout ladder level off capacity() (ISSUE 20): a browned-
+        #: out host's headroom is discounted so the fleet routes new
+        #: work around local overload while the ladder sheds it
+        self.overload_level = 0
 
     # breaker state read-throughs (tests and snapshots read these; all
     # WRITES go through the breaker's transition verbs)
@@ -554,7 +559,10 @@ class Router:
                 if s.kv_total:
                     avail = max(0.0, s.kv_free or 0) + (s.kv_cold or 0)
                     kv = min(1.0, avail / s.kv_total)
-                return free * kv
+                # brownout discount (ISSUE 20): each ladder level halves
+                # the advertised room — a browned-out host keeps serving
+                # but stops attracting NEW work over healthy peers
+                return free * kv / (1 << min(s.overload_level, 4))
 
             scores = {
                 s.host_id: (room(s)
@@ -569,9 +577,13 @@ class Router:
         # score each host exactly once (nothing can change under the
         # held lock): the digest walks are the lock's hot-path cost
         bonuses = {s.host_id: bonus(s) for s in candidates}
+        # the brownout penalty mirrors the headroom policy's discount
+        # (ISSUE 20): one load_weight unit per ladder level, so a
+        # browned-out host loses affinity ties to healthy peers
         scores = {
             s.host_id: (self.affinity_weight * bonuses[s.host_id]
-                        - self.load_weight * s.outstanding / s.weight)
+                        - self.load_weight * s.outstanding / s.weight
+                        - self.load_weight * s.overload_level)
             for s in candidates}
         best_score = max(scores[s.host_id] for s in open_hosts)
         ties = [s for s in open_hosts if scores[s.host_id] == best_score]
@@ -780,6 +792,7 @@ class Router:
             ps = cap.get("kv_parked_sessions")
             state.kv_parked_sessions = (int(ps) if ps is not None
                                         else None)
+            state.overload_level = int(cap.get("overload_level") or 0)
             state.health_status = str(
                 health.get("status") or "ok")
             # gauge published under the same lock as the membership

@@ -638,8 +638,16 @@ def healthz_report() -> dict:
         elif verdict in ("fallback", "unreadable", "corrupt") \
                 and status == "ok":
             status = "degraded"
+    ov = facts.get("overload")
+    if (isinstance(ov, dict) and int(ov.get("level") or 0) > 0
+            and status == "ok"):
+        # the brownout ladder is above normal (ISSUE 20): degraded,
+        # never unhealthy — the host is deliberately shedding load and
+        # steps back down on its own hysteresis
+        status = "degraded"
     return {
         "status": status,
+        "overload": ov if isinstance(ov, dict) else None,
         "replica_pools": pools,
         "kv_pools": kv_pools,
         "autoscalers": autoscalers,

@@ -90,6 +90,7 @@ KNOWN_SITES = (
     "kv.unpark",
     "digest.delta",
     "kv.migrate",
+    "tenant.preempt",
 )
 
 _M_INJECTED = None

@@ -64,9 +64,18 @@ from sparkdl_tpu.serving.spec_decode import (
     NGramDraftSource,
     PrefixCacheDraftSource,
 )
+from sparkdl_tpu.serving.tenancy import (
+    PRIORITY_BACKGROUND,
+    PRIORITY_INTERACTIVE,
+    BrownoutShedError,
+    OverloadController,
+    TenantRegistry,
+    TenantThrottledError,
+)
 
 __all__ = [
     "AllReplicasQuarantinedError",
+    "BrownoutShedError",
     "ChainedDraftSource",
     "ContinuousGPTEngine",
     "DeadlineExceededError",
@@ -76,6 +85,9 @@ __all__ = [
     "KVBlockPool",
     "MicroBatcher",
     "NGramDraftSource",
+    "OverloadController",
+    "PRIORITY_BACKGROUND",
+    "PRIORITY_INTERACTIVE",
     "PrefixCache",
     "PrefixCacheDraftSource",
     "QueueFullError",
@@ -84,5 +96,7 @@ __all__ = [
     "RequestQueue",
     "ServingEngine",
     "ServingMetrics",
+    "TenantRegistry",
+    "TenantThrottledError",
     "failure_reason",
 ]

@@ -112,6 +112,7 @@ from sparkdl_tpu.runtime.dispatch import (
     SpecPolicy,
     record_dispatch,
 )
+from sparkdl_tpu.serving import tenancy
 from sparkdl_tpu.serving.metrics import ServingMetrics
 from sparkdl_tpu.serving.queue import (
     DeadlineExceededError,
@@ -171,6 +172,11 @@ _SPEC_TOTALS_LOCK = threading.Lock()
 #: writes a postmortem (one defer is normal backpressure; a streak is
 #: the incident an operator will ask about).
 _EXHAUST_DUMP_STREAK = 3
+
+#: Seconds between brownout-controller evaluations fed by the engine
+#: tick (ISSUE 20): the ladder's hysteresis counts these evaluations,
+#: so the stride — not the tick rate — sets its reaction time.
+_OVERLOAD_STRIDE_S = 0.25
 
 
 @dataclasses.dataclass
@@ -306,6 +312,7 @@ class ContinuousGPTEngine:
                  kv_spill_dir: "str | None" = None,
                  metrics: ServingMetrics | None = None,
                  slo: "slo_mod.SLO | None" = None,
+                 tenants: "tenancy.TenantRegistry | None" = None,
                  host_id: "str | None" = None,
                  auto_start: bool = True):
         import jax
@@ -411,7 +418,11 @@ class ContinuousGPTEngine:
             # auto mode reads the gap per tick: calibrate once here,
             # outside the engine lock, never inside the decode loop
             self._chain_policy.gap()
-        self.queue = RequestQueue(max_depth=max_queue_depth)
+        self.queue = RequestQueue(max_depth=max_queue_depth,
+                                  tenants=tenants)
+        #: next monotonic stamp the tick feeds the process brownout
+        #: controller (bounded evaluation stride, not per-tick)
+        self._overload_next = 0.0
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self._model = GPTLMHeadModel(config)
         self._len_buckets = default_buckets(max_len, min_bucket=8)
@@ -1038,9 +1049,17 @@ class ContinuousGPTEngine:
 
     # -- submission ----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int, *,
-               timeout_s: float | None = None) -> Future:
+               timeout_s: float | None = None,
+               tenant: str = "default",
+               priority: "int | None" = None) -> Future:
         """Admit one prompt; Future resolves to the generated ids
-        (np.int32 array, ``<= max_new_tokens`` long — shorter on eos)."""
+        (np.int32 array, ``<= max_new_tokens`` long — shorter on eos).
+
+        ``tenant``/``priority`` scope the request for quota, DRR
+        weight, and class scheduling (ISSUE 20) — the defaults are the
+        bitwise-compatible single-user path. See
+        :meth:`RequestQueue.submit` for the typed admission rejects
+        (``TenantThrottledError``/``BrownoutShedError``)."""
         from sparkdl_tpu.runtime.batching import pick_bucket
 
         prompt = np.asarray(prompt_ids, np.int32)
@@ -1095,7 +1114,8 @@ class ContinuousGPTEngine:
                     "request"
                 )
         return self.queue.submit(
-            GenRequest(prompt, max_new_tokens), timeout_s=timeout_s
+            GenRequest(prompt, max_new_tokens), timeout_s=timeout_s,
+            tenant=tenant, priority=priority,
         )
 
     def _admission_budget_tokens(self, max_new_tokens: int) -> int:
@@ -1251,10 +1271,18 @@ class ContinuousGPTEngine:
         ``while True: tick()``."""
         with self._lock:
             now = time.monotonic()
+            self._overload_tick(now)
             self._expire_inflight(now)
             free = [s for s in range(self.n_slots)
                     if s not in self._inflight
                     and s not in self._prefilling]
+            if not free and self._prefilling:
+                # saturated with a background prefill in flight: a more
+                # urgent waiting class may claim its slot (ISSUE 20)
+                if self._maybe_preempt(now):
+                    free = [s for s in range(self.n_slots)
+                            if s not in self._inflight
+                            and s not in self._prefilling]
             if free:
                 wait = (0.0 if self._inflight or self._prefilling
                         else self.idle_wait_s)
@@ -1350,6 +1378,79 @@ class ContinuousGPTEngine:
                 pool="sp_staging" if staging else "decode",
                 blocks_total=pool.n_blocks,
             )
+
+    def _maybe_preempt(self, now: float) -> bool:
+        """Priority preemption between prefill chunks (ISSUE 20): with
+        every slot busy and a strictly more urgent class waiting, tear
+        down the LEAST urgent background prefill and re-queue its
+        request at its own class head — zero lost. Only requests in
+        the background class (priority >= PRIORITY_BACKGROUND) are
+        preemptible, and only BETWEEN chunks (mid-dispatch state never
+        exists at tick boundaries). The victim's pool references go
+        back through the prefix cache, so its already-registered
+        prefix blocks stay cached (and parkable via the kv_tiers
+        path): the re-run prefills only what the cache cannot serve.
+        The ``tenant.preempt`` fault site fires before teardown; an
+        injected fault still re-queues the victim (chaos contract) —
+        it only suppresses the slot handover this tick. Returns True
+        when a slot was freed. Called under the engine lock."""
+        waiting = self.queue.highest_waiting_priority()
+        if waiting is None:
+            return False
+        slot, st = max(self._prefilling.items(),
+                       key=lambda kv: kv[1].req.priority)
+        if (st.req.priority < tenancy.PRIORITY_BACKGROUND
+                or waiting >= st.req.priority):
+            return False
+        fault: "Exception | None" = None
+        try:
+            fault_point("tenant.preempt")
+        except Exception as e:
+            fault = e
+        # the same teardown discipline as _sp_abort: drop the prefill
+        # record, release staging + every pool reference, THEN requeue
+        # — on the fault path too, so the victim is never lost
+        del self._prefilling[slot]
+        self._release_sp_staging(st)
+        self._prefix.release(st.all_blocks())
+        if fault is None:
+            tenancy.note_preemption()
+            flight_mod.record_event(
+                "tenant.preempted",
+                request_id=st.req.request_id, tenant=st.req.tenant,
+                victim_priority=st.req.priority,
+                waiting_priority=waiting,
+                prefilled=st.pos, prompt_tokens=len(st.prompt))
+        else:
+            flight_mod.record_event(
+                "tenant.preempt_failed",
+                error=type(fault).__name__,
+                request_id=st.req.request_id, tenant=st.req.tenant)
+        self.queue.requeue([st.req])
+        return fault is None
+
+    def _overload_tick(self, now: float) -> None:
+        """Feed the process brownout controller (when installed) this
+        engine's overload signals — worst SLO burn rate across
+        dimensions plus queue fill fraction — on a bounded stride, so
+        the ladder's hysteresis counts wall-clock-ish evaluations, not
+        raw tick rate. No controller installed = zero work (the
+        bitwise default path)."""
+        ctrl = tenancy.process_overload()
+        if ctrl is None or now < self._overload_next:
+            return
+        self._overload_next = now + _OVERLOAD_STRIDE_S
+        burn = None
+        if self.slo_tracker is not None:
+            rep = self.slo_tracker.sample()
+            burns = [d["burn_rate"] for d in
+                     (rep.get("latency"), rep.get("availability"))
+                     if isinstance(d, dict)]
+            if burns:
+                burn = max(burns)
+        ctrl.evaluate(
+            burn_rate=burn,
+            queue_frac=self.queue.depth / self.queue.max_depth)
 
     def _admit(self, slot: int, req: Request) -> bool:
         """Place one taken request into ``slot``. Returns False when the
@@ -2023,6 +2124,8 @@ class ContinuousGPTEngine:
         configured/auto cap under the shared budget/deadline bound,
         rounded down to a power of two — at most log2(cap) compiled
         chain programs ever exist."""
+        if tenancy.overload_level() >= tenancy.LEVEL_DEGRADE:
+            return 1  # brownout: shed chained-decode burstiness first
         cap = (self.chain_tokens if self.chain_tokens is not None
                else self._chain_policy.chain_len())
         cap = self._bounded_tokens(now, cap)
@@ -2039,6 +2142,8 @@ class ContinuousGPTEngine:
         mid-flight instead of expiring inside a wide verify. Power of
         two: {2,4,8,...} compiled verify programs, never one per width.
         """
+        if tenancy.overload_level() >= tenancy.LEVEL_DEGRADE:
+            return 1  # brownout: wasted verify FLOPs are shed first
         cap = min(self.spec_k, self._spec_policy.spec_len())
         cap = self._bounded_tokens(now, cap)
         if cap < 2:
@@ -2284,6 +2389,10 @@ class ContinuousGPTEngine:
             np.asarray(flight.produced, np.int32)
         )
         self.metrics.record_request(now - flight.req.enqueued, ok=True)
+        reg = self.queue.tenants
+        if reg is not None:
+            reg.note_outcome(flight.req.tenant,
+                             now - flight.req.enqueued, ok=True)
 
     def _fail_request(self, req: Request, exc: Exception, *,
                       tokens: int) -> None:
@@ -2298,6 +2407,9 @@ class ContinuousGPTEngine:
         req.future.set_exception(exc)
         record_request_failure(exc, request_id=req.request_id)
         self.metrics.record_request(now - req.enqueued, ok=False)
+        reg = self.queue.tenants
+        if reg is not None:
+            reg.note_outcome(req.tenant, now - req.enqueued, ok=False)
 
     def _expire_inflight(self, now: float) -> None:
         for slot in list(self._inflight):
@@ -2440,6 +2552,12 @@ class ContinuousGPTEngine:
         spec = self._spec_snapshot()
         if spec is not None:
             out["spec"] = spec
+        ctrl = tenancy.process_overload()
+        if ctrl is not None:
+            out["overload"] = ctrl.snapshot()
+        reg = self.queue.tenants
+        if reg is not None:
+            out["tenants"] = reg.snapshot()
         return out
 
     def kv_autoscale_binding(self) -> "tuple[Any, Any]":
@@ -2492,6 +2610,10 @@ class ContinuousGPTEngine:
             "queue_depth": self.queue.depth,
             "max_queue_depth": self.queue.max_depth,
             "draining": self.queue.closed,
+            # brownout level (ISSUE 20): a router discounts a
+            # browned-out host's headroom so the fleet routes around
+            # local overload while the ladder sheds it
+            "overload_level": tenancy.overload_level(),
         }
 
     def snapshot(self) -> dict[str, Any]:

@@ -35,6 +35,7 @@ import numpy as np
 from sparkdl_tpu.observability import slo as slo_mod
 from sparkdl_tpu.observability import tracing
 from sparkdl_tpu.observability.exporters import maybe_start_metrics_server
+from sparkdl_tpu.serving import tenancy
 from sparkdl_tpu.serving.metrics import EngineObservability, ServingMetrics
 from sparkdl_tpu.serving.microbatcher import MicroBatcher
 from sparkdl_tpu.serving.queue import RequestQueue
@@ -60,6 +61,7 @@ class ServingEngine:
                  extract: Callable[[Any], dict[str, np.ndarray]] | None = None,
                  metrics: ServingMetrics | None = None,
                  slo: "slo_mod.SLO | None" = None,
+                 tenants: "tenancy.TenantRegistry | None" = None,
                  host_id: "str | None" = None):
         from sparkdl_tpu.serving.metrics import default_host_id
 
@@ -69,7 +71,8 @@ class ServingEngine:
         self.runner = runner
         #: stable host identity for the fabric's router tier (ISSUE 14)
         self.host_id = host_id if host_id is not None else default_host_id()
-        self.queue = RequestQueue(max_depth=max_queue_depth)
+        self.queue = RequestQueue(max_depth=max_queue_depth,
+                                  tenants=tenants)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.batcher = MicroBatcher(
             self.queue, runner, max_wait_s=max_wait_s, extract=extract,
@@ -84,12 +87,18 @@ class ServingEngine:
         self.slo_tracker = self._obs.tracker
 
     def submit(self, payload: Any, *,
-               timeout_s: float | None = None) -> Future:
+               timeout_s: float | None = None,
+               tenant: str = "default",
+               priority: "int | None" = None) -> Future:
         """Admit one request (a feature dict of per-row arrays, or
         whatever ``extract`` eats). Returns a Future resolving to the
         output row (carrying ``request_id``); raises QueueFullError /
-        EngineClosedError at the door."""
-        return self.queue.submit(payload, timeout_s=timeout_s)
+        EngineClosedError at the door. ``tenant``/``priority`` scope the
+        request for quota and class scheduling (ISSUE 20; the defaults
+        reproduce the single-user path) — over-quota and brownout sheds
+        raise the typed :mod:`~sparkdl_tpu.serving.tenancy` errors."""
+        return self.queue.submit(payload, timeout_s=timeout_s,
+                                 tenant=tenant, priority=priority)
 
     def trace(self, request_id: int) -> "list[dict]":
         """Every finished span of one request's trace (queue wait, batch
@@ -145,6 +154,7 @@ class ServingEngine:
             "queue_depth": self.queue.depth,
             "max_queue_depth": self.queue.max_depth,
             "draining": self.queue.closed,
+            "overload_level": tenancy.overload_level(),
         }
 
     def prefix_digest(self, max_entries: int = 1024) -> "dict | None":

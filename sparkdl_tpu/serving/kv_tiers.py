@@ -36,6 +36,7 @@ engine lock.
 from __future__ import annotations
 
 import collections
+import hashlib
 import json
 import os
 import shutil
@@ -43,6 +44,16 @@ import tempfile
 from typing import Callable, Dict, Hashable, List, Optional
 
 from sparkdl_tpu.observability.registry import GaugeShare, registry
+
+
+def _unlink_spill(path: str) -> None:
+    """Remove a spill file and its tmp/sidecar companions (best
+    effort): no publication artifact may outlive its disk-tier entry."""
+    for p in (path, path + ".tmp", path + ".sha256"):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
 
 _M_TIER = registry().gauge(
     "sparkdl_kv_tier_blocks",
@@ -213,12 +224,9 @@ class TieredKVStore:
             try:
                 payload = self._load(path)
             except Exception:
-                payload = None
+                payload = None  # torn/corrupt spill: prune, re-prefill
             finally:
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
+                _unlink_spill(path)
             if payload is not None:
                 _M_UNPARKS.inc(tier="disk")
             return payload
@@ -247,10 +255,7 @@ class TieredKVStore:
         if self._host.pop(node, None) is None:
             path = self._disk.pop(node, None)
             if path is not None:
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
+                _unlink_spill(path)
         self._update_gauges()
 
     def _trim_disk(self) -> List[Hashable]:
@@ -274,14 +279,25 @@ class TieredKVStore:
 
         self._seq += 1
         path = os.path.join(self._dir, f"kvblk-{self._seq:08d}.json")
+        # Crash-safe publication (ISSUE 20, the checkpoint-integrity
+        # scheme): serialize once, write to a tmp file, fsync, then
+        # os.replace into the final name with a sha256 sidecar — a
+        # writer killed mid-spill leaves a *.tmp (never adopted) or a
+        # digest mismatch, and _load turns either into the existing
+        # corrupt-unpark fallback (prune + re-prefill) instead of a
+        # json-decode crash on a torn file.
+        blob = json.dumps({k: _enc(v) for k, v in payload.items()})
+        tmp = path + ".tmp"
         try:
-            with open(path, "w") as f:
-                json.dump({k: _enc(v) for k, v in payload.items()}, f)
+            with open(tmp, "w") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            with open(path + ".sha256", "w") as f:
+                f.write(hashlib.sha256(blob.encode("utf-8")).hexdigest())
+            os.replace(tmp, path)
         except OSError:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+            _unlink_spill(path)
             return False
         self._disk[node] = path
         self._disk.move_to_end(node)
@@ -290,8 +306,16 @@ class TieredKVStore:
     def _load(self, path: str) -> Dict:
         from sparkdl_tpu.disagg.handoff import _dec
 
-        with open(path) as f:
-            blob = json.load(f)
+        with open(path + ".sha256") as f:
+            want = f.read().strip()
+        with open(path, "rb") as f:
+            raw = f.read()
+        got = hashlib.sha256(raw).hexdigest()
+        if got != want:
+            raise ValueError(
+                f"torn spill file {path}: sha256 {got[:12]} != "
+                f"sidecar {want[:12]}")
+        blob = json.loads(raw.decode("utf-8"))
         return {k: _dec(v) for k, v in blob.items()}
 
     def _update_gauges(self) -> None:
@@ -312,8 +336,5 @@ class TieredKVStore:
             shutil.rmtree(self._dir, ignore_errors=True)
         else:
             for path in self._disk.values():
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
+                _unlink_spill(path)
         self._disk.clear()

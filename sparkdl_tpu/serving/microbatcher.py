@@ -308,6 +308,9 @@ class MicroBatcher:
         else:
             req.future.set_result(result)
         self.metrics.record_request(latency, ok=error is None)
+        reg = getattr(self.queue, "tenants", None)
+        if reg is not None:
+            reg.note_outcome(req.tenant, latency, ok=error is None)
 
 
 def _row(out, i: int):

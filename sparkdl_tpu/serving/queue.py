@@ -10,6 +10,23 @@ honest under overload (the tf.data lesson: queue growth only moves the
 stall, it never removes it). Every request may carry a deadline; expired
 requests fail with :class:`DeadlineExceededError` at the next sweep
 instead of wasting a batch slot.
+
+Multi-tenant QoS (ISSUE 20): every request carries a ``tenant`` and an
+integer ``priority`` class (lower = more urgent; the defaults reproduce
+the old single-FIFO behavior bitwise). Internally the queue is a set of
+per-(priority, tenant) sub-queues: :meth:`take` serves classes in
+strict priority order and tenants *within* a class by deficit-weighted
+round-robin (weights from an attached
+:class:`~sparkdl_tpu.serving.tenancy.TenantRegistry`), so one tenant's
+deep backlog cannot monopolize micro-batch slots. The registry — when
+attached — also gates admission: an over-quota submit raises
+:class:`~sparkdl_tpu.serving.tenancy.TenantThrottledError` at the door,
+before consuming queue depth, and the process-wide brownout ladder
+(:class:`~sparkdl_tpu.serving.tenancy.OverloadController`) may shed the
+background class or everything. :meth:`requeue` returns a request to
+the head of ITS OWN class — a deferred or preempted background victim
+re-enters ahead of its class-mates but never jumps an interactive
+tenant.
 """
 
 from __future__ import annotations
@@ -19,10 +36,11 @@ import dataclasses
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any
+from typing import Any, Callable, Iterator
 
 from sparkdl_tpu.observability import flight, tracing
 from sparkdl_tpu.observability.registry import GaugeShare, registry
+from sparkdl_tpu.serving import tenancy
 
 # Registry mirrors of the queue's own counters (ISSUE 2: the spine sees
 # admission control without asking each engine for its snapshot). Family
@@ -128,6 +146,14 @@ class Request:
     #: keeps the original stamp, matching the wait histogram's
     #: first-take-only policy.
     taken_at: "float | None" = None
+    #: tenant identity (ISSUE 20): scopes quota, fair-share weight, and
+    #: per-tenant accounting. The default tenant — unconfigured — is
+    #: the bitwise-compatible single-user path.
+    tenant: str = "default"
+    #: priority class (lower = more urgent): classes are served in
+    #: strict order, and requeue/extract preserve class membership so
+    #: a background victim can never jump an interactive tenant.
+    priority: int = 0
 
     def expired(self, now: float | None = None) -> bool:
         return (self.deadline is not None
@@ -150,21 +176,171 @@ class Request:
             self.future.set_exception(exc)
 
 
+class _OneClass:
+    """One priority class: per-tenant FIFO deques + DRR rotation state
+    (mutated only under the owning queue's condition lock)."""
+
+    __slots__ = ("queues", "order", "ptr", "credit")
+
+    def __init__(self):
+        self.queues: "dict[str, collections.deque[Request]]" = {}
+        self.order: "list[str]" = []  # rotation order (arrival order)
+        self.ptr = 0
+        self.credit: "dict[str, float]" = {}
+
+
+class _FairQueue:
+    """Strict-priority classes, deficit-weighted round-robin tenants.
+
+    The drop-in replacement for the queue's old single deque: with one
+    tenant in one class (the default path) every operation degenerates
+    to the exact FIFO it replaced. ``weight_of`` maps a tenant to its
+    DRR share (>= 1; a weight-2 tenant drains two requests per
+    rotation visit for a weight-1 tenant's one). Unit-cost DRR: each
+    visit tops the tenant's credit up by its weight and serves while
+    credit lasts, so fractional weights never stall the rotation.
+    Iteration (and :meth:`drain`) walks classes in priority order and
+    tenants in rotation order — the class-preserving transfer order
+    ``extract_pending`` hands to a surviving host.
+    """
+
+    __slots__ = ("_classes", "_weight_of", "_n")
+
+    def __init__(self, weight_of: "Callable[[str], float]"):
+        self._classes: "dict[int, _OneClass]" = {}
+        self._weight_of = weight_of
+        self._n = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def _class(self, priority: int) -> _OneClass:
+        cls = self._classes.get(priority)
+        if cls is None:
+            cls = self._classes[priority] = _OneClass()
+        return cls
+
+    def _enqueue(self, req: Request, *, left: bool) -> None:
+        cls = self._class(req.priority)
+        q = cls.queues.get(req.tenant)
+        if q is None:
+            q = cls.queues[req.tenant] = collections.deque()
+            cls.order.append(req.tenant)
+        (q.appendleft if left else q.append)(req)
+        self._n += 1
+
+    def append(self, req: Request) -> None:
+        self._enqueue(req, left=False)
+
+    def appendleft(self, req: Request) -> None:
+        """Head of the request's OWN class — a requeued victim re-enters
+        ahead of its class-mates, never ahead of a more urgent class."""
+        self._enqueue(req, left=True)
+
+    def popnext(self) -> "Request | None":
+        """Next request: most urgent non-empty class, DRR tenant pick."""
+        for priority in sorted(self._classes):
+            cls = self._classes[priority]
+            req = self._pop_class(cls)
+            if req is not None:
+                if not cls.queues:
+                    del self._classes[priority]
+                self._n -= 1
+                return req
+            del self._classes[priority]
+        return None
+
+    def _pop_class(self, cls: _OneClass) -> "Request | None":
+        while cls.order:
+            idx = cls.ptr % len(cls.order)
+            tenant = cls.order[idx]
+            q = cls.queues.get(tenant)
+            if not q:
+                # drained tenant leaves the rotation; credit resets —
+                # an idle tenant must not bank a burst of turns
+                cls.order.pop(idx)
+                cls.queues.pop(tenant, None)
+                cls.credit.pop(tenant, None)
+                continue
+            credit = cls.credit.get(tenant, 0.0)
+            if credit < 1.0:
+                credit += max(1.0, self._weight_of(tenant))
+            credit -= 1.0
+            req = q.popleft()
+            if credit < 1.0:
+                cls.ptr = idx + 1
+            cls.credit[tenant] = credit
+            return req
+        return None
+
+    def highest_priority(self) -> "int | None":
+        """Most urgent class with queued work (None when empty) — the
+        engine's preemption test reads this without popping."""
+        live = [p for p, cls in self._classes.items()
+                if any(cls.queues.values())]
+        return min(live) if live else None
+
+    def __iter__(self) -> "Iterator[Request]":
+        for priority in sorted(self._classes):
+            cls = self._classes[priority]
+            order = [t for t in cls.order if cls.queues.get(t)]
+            if order:
+                pivot = cls.ptr % len(order)
+                order = order[pivot:] + order[:pivot]
+            for tenant in order:
+                yield from cls.queues.get(tenant, ())
+
+    def drain(self) -> "list[Request]":
+        """Remove and return everything, class order preserved."""
+        out = list(self)
+        self.clear()
+        return out
+
+    def clear(self) -> None:
+        self._classes.clear()
+        self._n = 0
+
+    def sweep(self, keep: "Callable[[Request], bool]") -> "list[Request]":
+        """Drop (and return) every request failing ``keep``, in place —
+        per-tenant FIFO order and DRR state untouched for survivors."""
+        removed: "list[Request]" = []
+        for priority in list(self._classes):
+            cls = self._classes[priority]
+            for tenant, q in list(cls.queues.items()):
+                live = [r for r in q if keep(r)]
+                if len(live) != len(q):
+                    removed.extend(r for r in q if not keep(r))
+                    q.clear()
+                    q.extend(live)
+        self._n -= len(removed)
+        return removed
+
+
 class RequestQueue:
-    """Thread-safe bounded FIFO of :class:`Request`.
+    """Thread-safe bounded multi-class queue of :class:`Request`.
 
     ``submit`` is the producer side (any number of caller threads);
     ``take`` is the consumer side (the dispatch loop). Expired requests
     are swept — failed with DeadlineExceededError, never handed to the
     batcher — on every take, and on submit when at capacity (so a full
     queue of dead requests does not reject live traffic).
+
+    ``tenants`` (a :class:`~sparkdl_tpu.serving.tenancy.TenantRegistry`,
+    settable any time) turns on per-tenant admission quotas and DRR
+    weights; without it every tenant passes freely at weight 1 and the
+    single default class is an exact FIFO — the pre-tenancy behavior.
     """
 
-    def __init__(self, max_depth: int = 256):
+    def __init__(self, max_depth: int = 256,
+                 tenants: "tenancy.TenantRegistry | None" = None):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
         self.max_depth = max_depth
-        self._dq: collections.deque[Request] = collections.deque()
+        #: per-tenant quota/weight policy (None = no tenancy: the
+        #: bitwise-compatible default). Plain attribute: operators may
+        #: attach/replace a registry on a live queue.
+        self.tenants = tenants
+        self._dq = _FairQueue(self._tenant_weight)
         self._cv = threading.Condition()
         self._closed = False
         #: the gauge carries the SUM over all live queues: each queue
@@ -178,6 +354,10 @@ class RequestQueue:
         self.cancelled = 0
         self.requeued = 0
 
+    def _tenant_weight(self, tenant: str) -> float:
+        reg = self.tenants
+        return reg.weight(tenant) if reg is not None else 1.0
+
     def _update_depth_locked(self) -> None:
         """Push this queue's depth change to the shared gauge as a delta
         (called under ``self._cv``)."""
@@ -186,6 +366,14 @@ class RequestQueue:
     @property
     def depth(self) -> int:
         return len(self._dq)
+
+    def highest_waiting_priority(self) -> "int | None":
+        """Most urgent class with queued work (None when empty) — the
+        engine's preemption test: when this is strictly more urgent
+        than an in-flight background prefill and no slot is free, the
+        engine may preempt (ISSUE 20)."""
+        with self._cv:
+            return self._dq.highest_priority()
 
     def pending_request_ids(self) -> "list[int]":
         """Request ids currently queued (flight-recorder postmortems
@@ -198,10 +386,26 @@ class RequestQueue:
         return self._closed
 
     def submit(self, payload: Any, *,
-               timeout_s: float | None = None) -> Future:
+               timeout_s: float | None = None,
+               tenant: str = "default",
+               priority: "int | None" = None) -> Future:
         """Enqueue; returns the request's Future. Raises
         :class:`QueueFullError` at capacity (after sweeping expired
         entries) and :class:`EngineClosedError` after close().
+
+        ``tenant``/``priority`` scope the request for quota and
+        scheduling (ISSUE 20): with a :attr:`tenants` registry attached
+        an over-quota submit raises
+        :class:`~sparkdl_tpu.serving.tenancy.TenantThrottledError`
+        BEFORE consuming queue depth, and the process-wide brownout
+        ladder may shed it
+        (:class:`~sparkdl_tpu.serving.tenancy.BrownoutShedError`) —
+        both typed admission rejects, never timeouts. ``priority=None``
+        resolves to the tenant's configured default class, else the
+        interactive class 0. Quota sheds do NOT count into
+        ``sparkdl_queue_rejected_total`` — a flooder's shed overage
+        must not burn the fleet availability SLO the compliant tenants
+        are measured by (it lands in ``sparkdl_tenant_shed_total``).
 
         Submit vs a concurrent ``close()`` is deterministic: both take
         the queue's condition lock, so a submit either wins the race (its
@@ -215,6 +419,26 @@ class RequestQueue:
         tracing is on)."""
         now = time.monotonic()
         deadline = now + timeout_s if timeout_s is not None else None
+        reg = self.tenants
+        prio = priority
+        if prio is None and reg is not None:
+            prio = reg.default_priority(tenant)
+        if prio is None:
+            prio = tenancy.PRIORITY_INTERACTIVE
+        # tenancy gates run BEFORE the queue lock (they take the
+        # registry's own lock) and before depth is consumed: shed
+        # traffic never holds a slot it is not getting
+        ctrl = tenancy.process_overload()
+        if ctrl is not None:
+            try:
+                ctrl.admission_check(tenant, prio)
+            except tenancy.BrownoutShedError:
+                if reg is not None:
+                    reg.count_shed(tenant)
+                raise
+        if reg is not None:
+            reg.admit(tenant, now,
+                      cost=ctrl.admit_cost() if ctrl is not None else 1.0)
         rid = tracing.next_request_id()
         with self._cv:
             if self._closed:
@@ -235,6 +459,7 @@ class RequestQueue:
                 trace_ctx=tracing.request_context(rid),
                 request_id=rid,
                 submitter_ctx=tracing.current_context(),
+                tenant=tenant, priority=prio,
             ))
             self.submitted += 1
             _M_SUBMITTED.inc()
@@ -265,7 +490,9 @@ class RequestQueue:
                 self._cv.wait(remaining)
             now = time.monotonic()
             while self._dq and len(out) < max_n:
-                req = self._dq.popleft()
+                req = self._dq.popnext()
+                if req is None:
+                    break
                 if req.expired(now):
                     self.expired += 1
                     _M_EXPIRED.inc()
@@ -319,8 +546,7 @@ class RequestQueue:
             exc = EngineClosedError("engine shut down before dispatch")
         n = 0
         with self._cv:
-            while self._dq:
-                req = self._dq.popleft()
+            for req in self._dq.drain():
                 if req.started or req.future.set_running_or_notify_cancel():
                     record_request_failure(exc, request_id=req.request_id)
                     req.future.set_exception(exc)
@@ -332,12 +558,19 @@ class RequestQueue:
         return n
 
     def requeue(self, requests: "list[Request]") -> None:
-        """Return taken requests to the queue HEAD, in order — deferred
-        admission (the engine took them but cannot place them yet, e.g.
-        the KV block pool is exhausted). They are retaken ahead of
-        everything submitted after them, so deferral never reorders
-        accepted traffic. Works on a closed queue: the requests were
-        admitted before close() and close keeps queued work takeable.
+        """Return taken requests to the head of their OWN CLASS, in
+        order — deferred admission (the engine took them but cannot
+        place them yet, e.g. the KV block pool is exhausted) and
+        priority preemption (the victim re-enters ahead of its
+        class-mates). Head-of-class, not head-of-global-FIFO
+        (ISSUE 20): a requeued background victim is retaken before
+        everything ITS class submitted after it, but an interactive
+        tenant's queued work still goes first — failover/preemption
+        cannot let background work jump the interactive classes. With
+        one tenant in one class (the default path) this is exactly the
+        old head-of-queue semantics. Works on a closed queue: the
+        requests were admitted before close() and close keeps queued
+        work takeable.
 
         The requests need not have come from THIS queue: a drained or
         failed host's unstarted requests (``extract_pending`` on the
@@ -405,10 +638,16 @@ class RequestQueue:
         moving, not dying. Deferred requests (``started=True``, taken
         once then re-queued on pool exhaustion) are included: they hold
         no device state, so they transfer as cleanly as fresh ones.
-        Call after :meth:`close` so no new submit races the drain."""
+        Call after :meth:`close` so no new submit races the drain.
+
+        Order is class-preserving (ISSUE 20): requests come out most
+        urgent class first, tenants within a class in their rotation
+        order — so a surviving host's :meth:`requeue` (which re-inserts
+        head-of-own-class) reproduces the same relative schedule the
+        dying host owed, and a background victim cannot jump an
+        interactive tenant through failover."""
         with self._cv:
-            out = list(self._dq)
-            self._dq.clear()
+            out = self._dq.drain()
             self._update_depth_locked()
         return out
 
@@ -421,12 +660,8 @@ class RequestQueue:
             self._sweep_expired_locked(time.monotonic())
 
     def _sweep_expired_locked(self, now: float) -> None:
-        live = [r for r in self._dq if not r.expired(now)]
-        for r in self._dq:
-            if r.expired(now):
-                self.expired += 1
-                _M_EXPIRED.inc()
-                r.fail_expired()
-        self._dq.clear()
-        self._dq.extend(live)
+        for r in self._dq.sweep(lambda r: not r.expired(now)):
+            self.expired += 1
+            _M_EXPIRED.inc()
+            r.fail_expired()
         self._update_depth_locked()

@@ -1,0 +1,227 @@
+"""Brownout ladder: hysteresis stepping, per-level responses, healthz.
+
+The :class:`OverloadController` is a pure tick-driven state machine —
+``evaluate()`` folds the two signals (SLO burn, queue fill fraction)
+and moves at most one level per call — so every contract here runs on
+counted evaluates with no wall clock: step UP only after ``hysteresis``
+consecutive hot ticks, step DOWN only after ``recovery_ticks``
+consecutive quiet ticks (recovery deliberately slower), a transition
+freezes movement for ``cooldown_ticks``, and a flapping signal never
+moves the ladder at all. The process-wide hook is exercised end to
+end: installed, level > 0 reads ``degraded`` in ``/healthz`` and
+background submits shed at the queue; recovered, healthz clears.
+"""
+
+import pytest
+
+from sparkdl_tpu.observability.flight import flight_recorder, healthz_report
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.serving import RequestQueue
+from sparkdl_tpu.serving.tenancy import (
+    LEVEL_DEGRADE,
+    LEVEL_NORMAL,
+    LEVEL_REJECT,
+    LEVEL_SHED_BACKGROUND,
+    LEVEL_THROTTLE,
+    PRIORITY_BACKGROUND,
+    BrownoutShedError,
+    OverloadController,
+    TenantRegistry,
+    set_process_overload,
+)
+
+
+def _ctrl(**kw):
+    kw.setdefault("burn_threshold", 2.0)
+    kw.setdefault("queue_threshold", 0.8)
+    kw.setdefault("hysteresis", 2)
+    kw.setdefault("recovery_ticks", 3)
+    kw.setdefault("cooldown_ticks", 2)
+    return OverloadController(**kw)
+
+
+def _hot(ctrl, n=1):
+    for _ in range(n):
+        level = ctrl.evaluate(burn_rate=10.0)
+    return level
+
+
+def _quiet(ctrl, n=1):
+    for _ in range(n):
+        level = ctrl.evaluate(burn_rate=0.0, queue_frac=0.0)
+    return level
+
+
+# -- stepping discipline ------------------------------------------------------
+
+def test_single_hot_evaluate_never_moves():
+    ctrl = _ctrl(hysteresis=2)
+    assert _hot(ctrl) == LEVEL_NORMAL
+    assert _quiet(ctrl) == LEVEL_NORMAL
+
+
+def test_steps_up_after_hysteresis_consecutive_hot_ticks():
+    ctrl = _ctrl(hysteresis=3, cooldown_ticks=0)
+    assert _hot(ctrl, 2) == LEVEL_NORMAL
+    assert _hot(ctrl) == LEVEL_SHED_BACKGROUND
+    assert ctrl.level_name == "shed_background"
+    assert ctrl.snapshot()["transitions"] == 1
+
+
+def test_either_signal_is_sufficient():
+    ctrl = _ctrl(hysteresis=1, cooldown_ticks=0)
+    assert ctrl.evaluate(queue_frac=0.9) == LEVEL_SHED_BACKGROUND
+    ctrl2 = _ctrl(hysteresis=1, cooldown_ticks=0)
+    # both below threshold: quiet, even with one of them None
+    assert ctrl2.evaluate(burn_rate=1.9) == LEVEL_NORMAL
+    assert ctrl2.evaluate(queue_frac=0.79) == LEVEL_NORMAL
+
+
+def test_cooldown_freezes_movement_after_a_transition():
+    ctrl = _ctrl(hysteresis=2, cooldown_ticks=2)
+    _hot(ctrl, 2)
+    assert ctrl.level == LEVEL_SHED_BACKGROUND
+    # the next 2 hot ticks only burn cooldown; the ladder holds
+    assert _hot(ctrl, 2) == LEVEL_SHED_BACKGROUND
+    # cooldown spent and the hot streak re-accumulated through it
+    assert _hot(ctrl) == LEVEL_DEGRADE
+
+
+def test_flapping_signal_never_moves_the_ladder():
+    """hot/quiet alternation resets both streaks every tick: a noisy
+    signal oscillating around the threshold must not flap the ladder."""
+    ctrl = _ctrl(hysteresis=2, recovery_ticks=2, cooldown_ticks=0)
+    for _ in range(20):
+        _hot(ctrl)
+        _quiet(ctrl)
+    assert ctrl.level == LEVEL_NORMAL
+    assert ctrl.snapshot()["transitions"] == 0
+
+
+def test_recovery_is_slower_than_escalation():
+    ctrl = _ctrl(hysteresis=2, recovery_ticks=3, cooldown_ticks=0)
+    _hot(ctrl, 2)
+    assert ctrl.level == LEVEL_SHED_BACKGROUND
+    assert _quiet(ctrl, 2) == LEVEL_SHED_BACKGROUND  # not yet
+    assert _quiet(ctrl) == LEVEL_NORMAL
+    snap = ctrl.snapshot()
+    assert snap["transitions"] == 2
+
+
+def test_ladder_walks_the_full_range_and_respects_max_level():
+    ctrl = _ctrl(hysteresis=1, cooldown_ticks=0, max_level=LEVEL_THROTTLE)
+    for want in (LEVEL_SHED_BACKGROUND, LEVEL_DEGRADE, LEVEL_THROTTLE):
+        assert _hot(ctrl) == want
+    # capped: more hot ticks never reach LEVEL_REJECT
+    assert _hot(ctrl, 5) == LEVEL_THROTTLE
+    # and all the way back down
+    full = _ctrl(hysteresis=1, recovery_ticks=1, cooldown_ticks=0)
+    assert _hot(full, 4) == LEVEL_REJECT
+    assert _quiet(full, 4) == LEVEL_NORMAL
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="hysteresis"):
+        OverloadController(hysteresis=0)
+    with pytest.raises(ValueError, match="recovery_ticks"):
+        OverloadController(recovery_ticks=0)
+    with pytest.raises(ValueError, match="max_level"):
+        OverloadController(max_level=7)
+
+
+# -- per-level responses ------------------------------------------------------
+
+def test_level_responses_compose_up_the_ladder():
+    ctrl = _ctrl(hysteresis=1, cooldown_ticks=0)
+    # level 0: everything passes, normal cost, full quality
+    ctrl.admission_check("acme", PRIORITY_BACKGROUND)
+    assert ctrl.admit_cost() == 1.0
+    assert not ctrl.degrade_quality()
+
+    _hot(ctrl)  # level 1: background shed, interactive passes
+    with pytest.raises(BrownoutShedError) as ei:
+        ctrl.admission_check("acme", PRIORITY_BACKGROUND)
+    assert ei.value.level == LEVEL_SHED_BACKGROUND
+    ctrl.admission_check("acme", 0)
+    assert not ctrl.degrade_quality()
+
+    _hot(ctrl)  # level 2: + quality degraded
+    assert ctrl.degrade_quality()
+    assert ctrl.admit_cost() == 1.0
+
+    _hot(ctrl)  # level 3: + double admit cost
+    assert ctrl.admit_cost() == 2.0
+    ctrl.admission_check("acme", 0)  # interactive still admitted
+
+    _hot(ctrl)  # level 4: everything shed
+    with pytest.raises(BrownoutShedError) as ei:
+        ctrl.admission_check("acme", 0)
+    assert ei.value.level == LEVEL_REJECT
+
+
+def test_transitions_land_in_flight_ring_and_metrics():
+    base = flight_recorder().events_total
+    ctrl = _ctrl(hysteresis=1, recovery_ticks=1, cooldown_ticks=0)
+    _hot(ctrl)
+    _quiet(ctrl)
+    evs = [e for e in flight_recorder().events()
+           if e["kind"] == "overload.level" and e["seq"] > base]
+    assert [e["direction"] for e in evs] == ["up", "down"]
+    assert evs[0]["name"] == "shed_background"
+    fam = registry().snapshot().get("sparkdl_overload_transitions_total")
+    assert fam["values"].get('direction="up"', 0) >= 1
+    assert fam["values"].get('direction="down"', 0) >= 1
+
+
+# -- process-wide hook: healthz + queue admission -----------------------------
+
+def test_installed_controller_degrades_healthz_until_recovery():
+    ctrl = _ctrl(hysteresis=1, recovery_ticks=1, cooldown_ticks=0)
+    prev = set_process_overload(ctrl)
+    try:
+        assert healthz_report()["overload"]["level"] == 0
+        _hot(ctrl)
+        hz = healthz_report()
+        assert hz["status"] == "degraded"
+        assert hz["overload"] == {"level": 1, "name": "shed_background"}
+        _quiet(ctrl)  # recovery clears healthz on its own
+        hz = healthz_report()
+        assert hz["status"] == "ok"
+        assert hz["overload"]["level"] == 0
+    finally:
+        set_process_overload(prev)
+    # cleared: the fact is gone, healthz back to ok with no overload row
+    assert healthz_report().get("overload") is None
+
+
+def test_queue_sheds_background_then_everything_zero_slo_burn():
+    """With the controller installed, the queue enforces the ladder at
+    submit: level 1 sheds PRIORITY_BACKGROUND (typed, counted per
+    tenant), level 4 sheds all — and neither touches the global
+    availability counter ``sparkdl_queue_rejected_total`` (a brownout
+    shed is policy, not a capacity failure)."""
+    def _rejected():
+        fam = registry().snapshot().get("sparkdl_queue_rejected_total")
+        return sum(fam["values"].values()) if fam else 0.0
+
+    reg = TenantRegistry()
+    ctrl = _ctrl(hysteresis=1, cooldown_ticks=0)
+    prev = set_process_overload(ctrl)
+    try:
+        q = RequestQueue(max_depth=8, tenants=reg)
+        base = _rejected()
+        _hot(ctrl)  # level 1
+        fut = q.submit("fg", tenant="acme")  # interactive: admitted
+        with pytest.raises(BrownoutShedError):
+            q.submit("bg", tenant="batch",
+                     priority=PRIORITY_BACKGROUND)
+        _hot(ctrl, 3)  # level 4
+        with pytest.raises(BrownoutShedError):
+            q.submit("fg2", tenant="acme")
+        assert _rejected() == base
+        assert reg.snapshot()["batch"]["shed"] == 1
+        assert reg.snapshot()["acme"]["shed"] == 1
+        assert [r.payload for r in q.take(4, 0.0)] == ["fg"]
+        assert not fut.done()
+    finally:
+        set_process_overload(prev)

@@ -1,0 +1,146 @@
+"""Disk-tier crash safety (ISSUE 20): a writer killed mid-spill must
+never crash the store or resurrect torn bytes.
+
+The spill path publishes atomically (tmp + fsync + sha256 sidecar +
+``os.replace``), so every kill point leaves exactly one of three
+observable states: an orphaned ``*.tmp`` (never adopted), a final file
+whose digest disagrees with its sidecar, or a final file with no
+sidecar. These tests manufacture each state directly on a spilled
+entry and assert the one contract that matters: ``fetch`` returns
+``None`` (the caller's existing re-prefill fallback) and prunes every
+companion file — never a ``json.JSONDecodeError`` out of a torn file,
+never stale bytes served as KV state.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.serving.kv_tiers import TieredKVStore
+
+
+def _payload(tag):
+    rng = np.random.default_rng(tag)
+    return {
+        "k": rng.standard_normal((2, 4, 2, 3)).astype(np.float32),
+        "v": rng.integers(-128, 127, (2, 4, 2, 3)).astype(np.int8),
+    }
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = TieredKVStore(host_blocks=1, disk_blocks=4,
+                      spill_dir=str(tmp_path))
+    yield s
+    s.close()
+
+
+def _spill_one(store, node, tag):
+    """Park ``node`` then push it to the disk tier with a second park,
+    returning its spill path."""
+    assert store.park(node, _payload(tag)) == []
+    assert store.park(("filler", tag), _payload(tag + 1000)) == []
+    assert store.tier_of(node) == "disk"
+    (path,) = [p for p in glob.glob(
+        os.path.join(store._dir, "kvblk-*.json"))
+        if store._disk[node] == p]
+    return path
+
+
+def _companions(path):
+    return [p for p in (path, path + ".tmp", path + ".sha256")
+            if os.path.exists(p)]
+
+
+def test_intact_spill_round_trips_bitwise(store):
+    want = _payload(1)
+    _spill_one(store, "sess", 1)
+    got = store.fetch("sess")
+    assert got is not None
+    # dtype-faithful: the int8 codes come back as int8, bit-for-bit
+    for key in ("k", "v"):
+        assert got[key].dtype == want[key].dtype
+        np.testing.assert_array_equal(got[key], want[key])
+    assert "sess" not in store
+
+
+def test_truncated_spill_file_fetches_none_and_prunes(store):
+    """Kill point: final file adopted but torn short (partial page
+    writeback). The sidecar digest disagrees -> prune, not crash."""
+    path = _spill_one(store, "sess", 2)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    assert store.fetch("sess") is None
+    assert _companions(path) == []
+    assert "sess" not in store
+    assert store.disk_used == 0
+    assert store.tier_of(("filler", 2)) == "host"  # untouched
+
+
+def test_corrupted_bytes_fetch_none_not_json_error(store):
+    """Same-length garbage: json would decode *something* plausible or
+    explode; the digest check rejects it before json ever runs."""
+    path = _spill_one(store, "sess", 3)
+    size = os.path.getsize(path)
+    with open(path, "wb") as f:
+        f.write(b"\xff" * size)
+    assert store.fetch("sess") is None
+    assert _companions(path) == []
+
+
+def test_missing_sidecar_fetches_none_and_prunes(store):
+    """Kill point: killed between the payload write and the sidecar
+    write, with the final name somehow adopted (e.g. a restored
+    backup). No digest to trust -> treat as torn."""
+    path = _spill_one(store, "sess", 4)
+    os.unlink(path + ".sha256")
+    assert store.fetch("sess") is None
+    assert _companions(path) == []
+
+
+def test_orphaned_tmp_never_adopted_and_swept_on_fetch(store):
+    """Kill point: before ``os.replace`` — the final name does not
+    exist, only ``*.tmp``. The entry reads as lost (None) and the
+    orphan is swept with the prune."""
+    path = _spill_one(store, "sess", 5)
+    os.rename(path, path + ".tmp")  # rewind the publication
+    assert store.fetch("sess") is None
+    assert _companions(path) == []
+
+
+def test_peek_on_torn_file_is_none_but_nondestructive(store):
+    """The migration-export read reports the corruption (None) without
+    mutating the tier — the entry stays resident until an owner
+    decision (fetch/drop) prunes it."""
+    path = _spill_one(store, "sess", 6)
+    with open(path, "ab") as f:
+        f.write(b"garbage")
+    assert store.peek("sess") is None
+    assert store.tier_of("sess") == "disk"
+    assert _companions(path) != []
+    assert store.fetch("sess") is None  # the owner prunes
+    assert _companions(path) == []
+
+
+def test_drop_removes_every_companion_file(store):
+    path = _spill_one(store, "sess", 7)
+    open(path + ".tmp", "w").write("orphan")  # simulate a stale tmp
+    store.drop("sess")
+    assert _companions(path) == []
+    assert store.disk_used == 0
+    assert store.tier_of(("filler", 7)) == "host"
+
+
+def test_close_with_external_dir_unlinks_spills(tmp_path):
+    s = TieredKVStore(host_blocks=1, disk_blocks=4,
+                      spill_dir=str(tmp_path))
+    s.park("a", _payload(8))
+    s.park("b", _payload(9))
+    assert glob.glob(str(tmp_path / "kvblk-*"))
+    s.close()
+    # the directory is the caller's; its spill artifacts are ours
+    assert glob.glob(str(tmp_path / "kvblk-*")) == []
+    assert tmp_path.exists()

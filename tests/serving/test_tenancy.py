@@ -1,0 +1,496 @@
+"""Multi-tenant QoS (ISSUE 20): quotas, weighted-fair scheduling,
+priority preemption, and the hot-tenant isolation soak.
+
+Layered like the feature: token-bucket mechanics and registry policy
+first (pure fake-clock unit tests), then the queue's DRR schedule
+(exact deterministic interleave), then the engine's between-chunks
+preemption (manual-tick ContinuousGPTEngine, success AND injected
+``tenant.preempt`` fault — zero lost either way), and finally the
+storm soak: one flooder offered ~10x its quota against two compliant
+tenants, whose p95 and rolling SLO compliance must stay within 10% of
+their flooder-free baselines while the flooder's overage is shed as
+:class:`TenantThrottledError` — typed, at the door, never a timeout.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.observability.flight import flight_recorder
+from sparkdl_tpu.observability.registry import registry
+from sparkdl_tpu.reliability.faults import inject
+from sparkdl_tpu.serving import RequestQueue
+from sparkdl_tpu.serving.tenancy import (
+    PRIORITY_BACKGROUND,
+    TenantRegistry,
+    TenantThrottledError,
+    TokenBucket,
+)
+
+
+def _counter(name, label=None):
+    fam = registry().snapshot().get(name)
+    if fam is None:
+        return 0.0
+    values = fam["values"]
+    if label is None:
+        return sum(values.values())
+    return values.get(label, 0.0)
+
+
+# -- token bucket (fake clock throughout) -------------------------------------
+
+class TestTokenBucket:
+    def test_burst_then_empty_then_refill(self):
+        b = TokenBucket(rate=2.0, burst=3, now=0.0)
+        assert [b.try_acquire(0.0) for _ in range(4)] == [
+            True, True, True, False]
+        assert not b.try_acquire(0.4)  # 0.8 tokens: still short
+        assert b.try_acquire(0.5)      # 1.0 token refilled
+        assert not b.try_acquire(0.5)
+
+    def test_refill_clamps_at_burst(self):
+        b = TokenBucket(rate=100.0, burst=2, now=0.0)
+        assert b.try_acquire(1000.0)
+        assert b.try_acquire(1000.0)
+        assert not b.try_acquire(1000.0)
+
+    def test_cost_supports_brownout_double_charge(self):
+        b = TokenBucket(rate=1.0, burst=4, now=0.0)
+        assert b.try_acquire(0.0, cost=2.0)
+        assert b.try_acquire(0.0, cost=2.0)
+        assert not b.try_acquire(0.0, cost=2.0)
+
+    def test_reconfigure_clamps_tokens_to_new_burst(self):
+        b = TokenBucket(rate=1.0, burst=10, now=0.0)
+        b.reconfigure(burst=2)
+        assert b.tokens == 2.0
+        b.reconfigure(rate=50.0)
+        assert b.try_acquire(0.1)  # new rate applies from now
+        assert b.try_acquire(0.1)
+
+    def test_time_never_runs_backwards(self):
+        b = TokenBucket(rate=1.0, burst=1, now=10.0)
+        assert b.try_acquire(10.0)
+        assert not b.try_acquire(5.0)  # stale clock: no refill, no crash
+        assert b.try_acquire(11.5)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="rate"):
+            TokenBucket(rate=0.0, burst=1)
+        with pytest.raises(ValueError, match="burst"):
+            TokenBucket(rate=1.0, burst=0)
+
+
+# -- registry policy ----------------------------------------------------------
+
+class TestTenantRegistry:
+    def test_unconfigured_tenant_passes_freely_weight_one(self):
+        reg = TenantRegistry()
+        for _ in range(100):
+            reg.admit("anyone")
+        assert reg.weight("anyone") == 1.0
+        assert reg.default_priority("anyone") is None
+
+    def test_over_quota_sheds_typed_and_counted(self):
+        t = [0.0]
+        reg = TenantRegistry(clock=lambda: t[0])
+        reg.configure("flood", rate=1.0, burst=2)
+        reg.admit("flood")
+        reg.admit("flood")
+        with pytest.raises(TenantThrottledError) as ei:
+            reg.admit("flood")
+        assert ei.value.tenant == "flood"
+        snap = reg.snapshot()["flood"]
+        assert snap["admitted"] == 2 and snap["shed"] == 1
+        assert _counter("sparkdl_tenant_shed_total",
+                        'tenant="flood"') >= 1
+        t[0] = 1.0  # one token refilled: admission reopens
+        reg.admit("flood")
+
+    def test_rate_alone_defaults_burst_and_runtime_reconfigure(self):
+        t = [0.0]
+        reg = TenantRegistry(clock=lambda: t[0])
+        reg.configure("acme", rate=5.0)
+        assert reg.snapshot()["acme"]["bucket"]["burst"] == 5.0
+        reg.configure("acme", rate=5.0, burst=1)  # live re-declare
+        reg.admit("acme")
+        with pytest.raises(TenantThrottledError):
+            reg.admit("acme")
+
+    def test_burst_without_rate_rejected(self):
+        reg = TenantRegistry()
+        with pytest.raises(ValueError, match="no rate yet"):
+            reg.configure("acme", burst=4)
+
+    def test_weight_and_priority_validation(self):
+        reg = TenantRegistry()
+        with pytest.raises(ValueError, match="weight"):
+            reg.configure("acme", weight=0.5)
+        reg.configure("acme", weight=3.0, priority=PRIORITY_BACKGROUND)
+        assert reg.weight("acme") == 3.0
+        assert reg.default_priority("acme") == PRIORITY_BACKGROUND
+
+    def test_slo_report_rolling_window(self):
+        t = [0.0]
+        reg = TenantRegistry(latency_threshold_s=0.1, window_s=10.0,
+                             clock=lambda: t[0])
+        for _ in range(8):
+            reg.note_outcome("acme", 0.05, ok=True)
+        reg.note_outcome("acme", 0.5, ok=True)   # latency miss
+        reg.note_outcome("acme", 0.05, ok=False)  # availability miss
+        row = reg.slo_report()["acme"]
+        # latency is judged on every sample (ok or not): 9/10 within
+        # threshold; availability on the ok flag alone: 9/10 ok
+        assert row["latency"]["compliance"] == 0.9
+        assert row["availability"]["compliance"] == 0.9
+        assert row["availability"]["burn_rate"] > 1.0
+        # published under the shared slo gauges, tenant-labelled
+        fam = registry().snapshot()["sparkdl_slo_compliance"]
+        key = 'slo="tenant:acme",dimension="latency"'
+        assert fam["values"][key] == 0.9
+        t[0] = 20.0  # the window rolls off: compliance resets to None
+        row = reg.slo_report()["acme"]
+        assert row["latency"]["compliance"] is None
+
+
+# -- weighted-fair, class-ordered queue ---------------------------------------
+
+class TestFairSchedule:
+    def test_drr_interleave_honors_weights(self):
+        reg = TenantRegistry()
+        reg.configure("a", weight=2.0)
+        q = RequestQueue(max_depth=32, tenants=reg)
+        for i in range(4):
+            q.submit(f"a{i}", tenant="a")
+        for i in range(4):
+            q.submit(f"b{i}", tenant="b")
+        taken = [r.payload for r in q.take(8, 0.0)]
+        # weight-2 "a" drains two per rotation visit for b's one
+        assert taken == ["a0", "a1", "b0", "a2", "a3", "b1", "b2", "b3"]
+
+    def test_one_tenant_backlog_cannot_starve_another(self):
+        q = RequestQueue(max_depth=64, tenants=TenantRegistry())
+        for i in range(20):
+            q.submit(f"hog{i}", tenant="hog")
+        q.submit("late", tenant="quiet")
+        first4 = [r.payload for r in q.take(4, 0.0)]
+        # equal weights: strict alternation, not 20-deep head-of-line
+        assert "late" in first4
+
+    def test_strict_priority_classes_before_drr(self):
+        q = RequestQueue(max_depth=32, tenants=TenantRegistry())
+        q.submit("bg0", tenant="batch", priority=PRIORITY_BACKGROUND)
+        q.submit("fg0", tenant="acme")
+        q.submit("bg1", tenant="batch", priority=PRIORITY_BACKGROUND)
+        q.submit("fg1", tenant="zeta")
+        taken = [r.payload for r in q.take(8, 0.0)]
+        assert taken == ["fg0", "fg1", "bg0", "bg1"]
+
+    def test_registry_default_priority_resolves_at_submit(self):
+        reg = TenantRegistry()
+        reg.configure("offline", priority=PRIORITY_BACKGROUND)
+        q = RequestQueue(max_depth=8, tenants=reg)
+        q.submit("bg", tenant="offline")  # no explicit priority
+        q.submit("fg", tenant="acme")
+        assert [r.payload for r in q.take(4, 0.0)] == ["fg", "bg"]
+        # explicit priority beats the tenant default
+        q.submit("urgent", tenant="offline", priority=0)
+        q.submit("fg2", tenant="acme")
+        (first, _) = q.take(4, 0.0)
+        assert first.payload == "urgent"
+
+    def test_requeue_heads_own_class_never_jumps_interactive(self):
+        q = RequestQueue(max_depth=32, tenants=TenantRegistry())
+        q.submit("bg0", tenant="batch", priority=PRIORITY_BACKGROUND)
+        q.submit("bg1", tenant="batch", priority=PRIORITY_BACKGROUND)
+        (victim,) = q.take(1, 0.0)
+        assert victim.payload == "bg0"
+        q.submit("fg0", tenant="acme")
+        q.requeue([victim])  # the preempted victim comes back
+        taken = [r.payload for r in q.take(8, 0.0)]
+        # head of ITS class (before bg1), behind every interactive
+        assert taken == ["fg0", "bg0", "bg1"]
+
+    def test_extract_pending_class_preserving_transfer(self):
+        reg = TenantRegistry()
+        src = RequestQueue(max_depth=32, tenants=reg)
+        dst = RequestQueue(max_depth=32, tenants=reg)
+        src.submit("bg", tenant="batch", priority=PRIORITY_BACKGROUND)
+        src.submit("fg-a", tenant="a")
+        src.submit("fg-b", tenant="b")
+        src.close()
+        moved = src.extract_pending()
+        assert [r.payload for r in moved] == ["fg-a", "fg-b", "bg"]
+        dst.submit("resident-bg", tenant="batch",
+                   priority=PRIORITY_BACKGROUND)
+        dst.requeue(moved)
+        taken = [r.payload for r in dst.take(8, 0.0)]
+        # classes re-form on the surviving queue: both interactive
+        # requests first (cross-tenant rotation order unspecified),
+        # the moved background head-of-class ahead of the resident
+        assert sorted(taken[:2]) == ["fg-a", "fg-b"]
+        assert taken[2:] == ["bg", "resident-bg"]
+
+
+# -- engine preemption (manual tick) ------------------------------------------
+
+class TestPreemption:
+    @pytest.fixture(scope="class")
+    def bundle(self):
+        import jax
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+
+        cfg = GPTConfig.tiny()
+        model = GPTLMHeadModel(cfg)
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+        return cfg, model, variables
+
+    @staticmethod
+    def _oracle(model, variables, prompt, max_new):
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.models.gpt import generate
+
+        out = generate(model, variables,
+                       jnp.asarray([prompt], jnp.int32), max_new)
+        return np.asarray(out[0, len(prompt):]).tolist()
+
+    def _engine(self, cfg, variables):
+        from sparkdl_tpu.serving import ContinuousGPTEngine
+
+        reg = TenantRegistry()
+        reg.configure("offline", priority=PRIORITY_BACKGROUND)
+        return ContinuousGPTEngine(
+            cfg, variables, n_slots=1, max_len=32, auto_start=False,
+            kv_block_size=4, prefill_chunk=4, tenants=reg)
+
+    @staticmethod
+    def _drain(eng, futs):
+        while not all(f.done() for f in futs):
+            eng.tick()
+
+    def test_interactive_arrival_preempts_background_prefill(
+            self, bundle):
+        cfg, model, variables = bundle
+        rng = np.random.default_rng(20)
+        bg_prompt = rng.integers(1, cfg.vocab_size, 12).tolist()
+        fg_prompt = rng.integers(1, cfg.vocab_size, 6).tolist()
+        base = flight_recorder().events_total
+        pre_m = _counter("sparkdl_tenant_preemptions_total")
+        with self._engine(cfg, variables) as eng:
+            f_bg = eng.submit(bg_prompt, 4, tenant="offline")
+            eng.tick()  # admit + first chunk: mid-prefill, slot held
+            assert eng._prefilling and not f_bg.done()
+            f_fg = eng.submit(fg_prompt, 4, tenant="acme")
+            eng.tick()  # saturated + more urgent waiting: preempt
+            self._drain(eng, [f_fg, f_bg])
+            # zero lost, both bitwise vs the unbatched oracle — the
+            # victim re-ran from its class head after the interactive
+            # request finished
+            assert (f_fg.result(timeout=0).tolist()
+                    == self._oracle(model, variables, fg_prompt, 4))
+            assert (f_bg.result(timeout=0).tolist()
+                    == self._oracle(model, variables, bg_prompt, 4))
+        assert _counter("sparkdl_tenant_preemptions_total") == pre_m + 1
+        evs = [e for e in flight_recorder().events()
+               if e["kind"] == "tenant.preempted" and e["seq"] > base]
+        assert len(evs) == 1
+        assert evs[0]["victim_priority"] == PRIORITY_BACKGROUND
+        assert evs[0]["waiting_priority"] == 0
+        assert 0 < evs[0]["prefilled"] < len(bg_prompt)
+
+    def test_injected_preempt_fault_still_requeues_victim(self, bundle):
+        """Chaos contract on ``tenant.preempt``: the fault suppresses
+        the slot handover, never the teardown — the victim re-queues
+        and BOTH requests complete bitwise-correct (zero lost)."""
+        cfg, model, variables = bundle
+        rng = np.random.default_rng(21)
+        bg_prompt = rng.integers(1, cfg.vocab_size, 12).tolist()
+        fg_prompt = rng.integers(1, cfg.vocab_size, 6).tolist()
+        base = flight_recorder().events_total
+        pre_m = _counter("sparkdl_tenant_preemptions_total")
+        with self._engine(cfg, variables) as eng:
+            f_bg = eng.submit(bg_prompt, 4, tenant="offline")
+            eng.tick()
+            f_fg = eng.submit(fg_prompt, 4, tenant="acme")
+            with inject("tenant.preempt:RuntimeError@1"):
+                eng.tick()  # preempt attempt fails mid-teardown
+            self._drain(eng, [f_fg, f_bg])
+            assert (f_fg.result(timeout=0).tolist()
+                    == self._oracle(model, variables, fg_prompt, 4))
+            assert (f_bg.result(timeout=0).tolist()
+                    == self._oracle(model, variables, bg_prompt, 4))
+        # not counted as a successful preemption, but observable
+        assert _counter("sparkdl_tenant_preemptions_total") == pre_m
+        evs = [e for e in flight_recorder().events()
+               if e["kind"] == "tenant.preempt_failed"
+               and e["seq"] > base]
+        assert len(evs) == 1 and evs[0]["error"] == "RuntimeError"
+
+    def test_interactive_prefill_is_never_preempted(self, bundle):
+        """Only the background class is preemptible: an interactive
+        prefill holds its slot against any arrival."""
+        cfg, _, variables = bundle
+        rng = np.random.default_rng(22)
+        with self._engine(cfg, variables) as eng:
+            f_a = eng.submit(
+                rng.integers(1, cfg.vocab_size, 12).tolist(), 2,
+                tenant="acme")
+            eng.tick()
+            assert eng._prefilling
+            f_b = eng.submit(
+                rng.integers(1, cfg.vocab_size, 6).tolist(), 2,
+                tenant="zeta")
+            eng.tick()
+            assert not eng._maybe_preempt(time.monotonic())
+            self._drain(eng, [f_a, f_b])
+        assert f_a.result(timeout=0) is not None
+        assert f_b.result(timeout=0) is not None
+
+
+# -- hot-tenant storm soak ----------------------------------------------------
+
+class TestHotTenantStorm:
+    """One flooder offered ~10x its quota against two compliant
+    tenants on a shared ServingEngine. The quota + DRR + accounting
+    stack must hold: victims' p95 and SLO compliance within 10% of
+    their flooder-free baselines, the flooder's overage shed as
+    :class:`TenantThrottledError` (typed, at the door — NEVER a
+    timeout), and zero accepted requests lost on either side."""
+
+    VICTIMS = ("acme", "zeta")
+    N_PER_VICTIM = 48
+    PACE_S = 0.01
+    SERVICE_S = 0.025   # fixed per-batch service time (see _Runner)
+    FLOOD_RATE = 40.0   # tokens/s quota...
+    FLOOD_BURST = 2
+    FLOOD_PACE_S = 0.001  # ...offered at ~1000/s: >>10x over
+
+    class _Runner:
+        """Latency must be dominated by a DETERMINISTIC term or the
+        10% isolation bound measures scheduler jitter, not isolation:
+        a fixed host-side sleep per batch makes every request cost
+        ~one service cycle. It has to live in a plain ``run_batch``
+        object — a sleep inside a BatchedRunner apply_fn is traced
+        ONCE by jit and compiled away — and the batch is sized (16) so
+        victims + the flooder's quota-capped residue can never
+        overflow it: the storm changes batch OCCUPANCY, never cycle
+        count."""
+
+        chunk_size = 16
+
+        def __init__(self, service_s):
+            self._service_s = service_s
+
+        def run_batch(self, arrays):
+            time.sleep(self._service_s)
+            return arrays["x"] * 2.0 + 1.0
+
+    def _run(self, *, flood):
+        from sparkdl_tpu.serving import ServingEngine
+
+        reg = TenantRegistry(latency_threshold_s=0.25, window_s=60.0)
+        reg.configure("flood", rate=self.FLOOD_RATE,
+                      burst=self.FLOOD_BURST)
+        runner = self._Runner(self.SERVICE_S)
+        lats = {t: [] for t in self.VICTIMS}
+        shed, flood_futs, offered = [], [], [0]
+        stop = threading.Event()
+        row = np.ones((2,), np.float32)
+
+        with ServingEngine(runner, max_wait_s=0.03,
+                           max_queue_depth=512, tenants=reg) as eng:
+            def flooder():
+                give_up = time.monotonic() + 60.0
+                while not stop.is_set() and time.monotonic() < give_up:
+                    offered[0] += 1
+                    try:
+                        flood_futs.append(
+                            eng.submit({"x": row}, tenant="flood"))
+                    except TenantThrottledError as e:
+                        shed.append(e)
+                    time.sleep(self.FLOOD_PACE_S)
+
+            th = threading.Thread(target=flooder, daemon=True)
+            if flood:
+                th.start()
+            victim_futs = []
+            try:
+                for _ in range(self.N_PER_VICTIM):
+                    for tenant in self.VICTIMS:
+                        t0 = time.perf_counter()
+                        f = eng.submit({"x": row}, tenant=tenant)
+                        f.add_done_callback(
+                            lambda f, t=tenant, s=t0:
+                            lats[t].append(time.perf_counter() - s))
+                        victim_futs.append(f)
+                    time.sleep(self.PACE_S)
+                # zero accepted lost: every victim AND every admitted
+                # flooder request resolves with a real result
+                for f in victim_futs:
+                    np.testing.assert_allclose(
+                        f.result(timeout=30), row * 2.0 + 1.0)
+            finally:
+                stop.set()
+                if flood:
+                    th.join(timeout=5)
+            for f in flood_futs:
+                np.testing.assert_allclose(
+                    f.result(timeout=30), row * 2.0 + 1.0)
+            deadline = time.monotonic() + 5.0
+            while (any(len(lats[t]) < self.N_PER_VICTIM
+                       for t in self.VICTIMS)
+                   and time.monotonic() < deadline):
+                time.sleep(0.001)
+        report = reg.slo_report()
+        p95 = {t: float(np.percentile(lats[t], 95))
+               for t in self.VICTIMS}
+        return {
+            "p95": p95,
+            "compliance": {
+                t: report[t]["latency"]["compliance"]
+                for t in self.VICTIMS},
+            "report": report,
+            "offered": offered[0],
+            "admitted": len(flood_futs),
+            "shed": shed,
+        }
+
+    def test_victims_isolated_flooder_shed_typed_zero_lost(self):
+        solo = self._run(flood=False)
+        storm = self._run(flood=True)
+
+        # the flood was real (~10x the quota) and the overage was shed
+        # at the door, every shed a typed TenantThrottledError (the
+        # except clause is the only collector; anything else — e.g. a
+        # DeadlineExceededError — would have failed the run)
+        assert storm["offered"] >= 3 * storm["admitted"]
+        assert storm["shed"], "flooder was never throttled"
+        assert all(isinstance(e, TenantThrottledError)
+                   for e in storm["shed"])
+        assert all(e.tenant == "flood" for e in storm["shed"])
+        flood_row = storm["report"]["flood"]
+        assert flood_row["shed"] == len(storm["shed"])
+        assert flood_row["admitted"] == storm["admitted"]
+        # the flooder's shed overage burned ITS OWN counters only — the
+        # global availability counter the fleet SLO is measured by
+        # never saw a quota shed (asserted in the metric families by
+        # the queue tests; here: accepted flooder traffic all finished)
+        assert flood_row["failed"] == 0
+
+        # isolation: each victim's p95 and rolling SLO compliance stay
+        # within 10% of its flooder-free baseline
+        for t in self.VICTIMS:
+            assert storm["p95"][t] <= 1.10 * solo["p95"][t], (
+                t, storm["p95"], solo["p95"])
+            assert (storm["compliance"][t]
+                    >= 0.90 * solo["compliance"][t]), (
+                t, storm["compliance"], solo["compliance"])
+            assert storm["report"][t]["failed"] == 0
+            assert storm["report"][t]["completed"] >= self.N_PER_VICTIM
